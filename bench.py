@@ -1,0 +1,87 @@
+"""Benchmark: sampled-edges/second (SEPS) on an ogbn-products-scale graph.
+
+Metric of record matches the reference (SEPS, benchmarks/sample/
+bench_sampler.py:14-16): ogbn-products GraphSAGE fanout [15, 10, 5],
+batch 1024. Baseline = single-GPU Quiver UVA 34.29M SEPS
+(docs/Introduction_en.md:38-45, BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Scale knobs (env): QT_BENCH_NODES, QT_BENCH_AVG_DEG, QT_BENCH_BATCHES,
+QT_BENCH_BATCH.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_SEPS = 34.29e6   # reference Quiver UVA, 1 GPU, products [15,10,5]
+
+
+def build_synthetic_products(n_nodes: int, avg_deg: int, seed: int = 0):
+    """Synthetic graph with ogbn-products-like scale and a skewed degree
+    profile (lognormal), CSR int32/int64 as CSRTopo decides."""
+    rng = np.random.default_rng(seed)
+    deg = rng.lognormal(mean=np.log(avg_deg), sigma=1.0, size=n_nodes)
+    deg = np.minimum(deg.astype(np.int64), 10_000)
+    indptr = np.zeros(n_nodes + 1, dtype=np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    e = int(indptr[-1])
+    indices = rng.integers(0, n_nodes, size=e, dtype=np.int32)
+    return indptr, indices, e
+
+
+def main():
+    n_nodes = int(os.environ.get("QT_BENCH_NODES", 2_450_000))
+    avg_deg = int(os.environ.get("QT_BENCH_AVG_DEG", 25))
+    batches = int(os.environ.get("QT_BENCH_BATCHES", 20))
+    batch = int(os.environ.get("QT_BENCH_BATCH", 1024))
+    sizes = [15, 10, 5]
+
+    import jax
+    import jax.numpy as jnp
+    from quiver_tpu.ops import sample_multihop
+
+    indptr_np, indices_np, e = build_synthetic_products(n_nodes, avg_deg)
+    dev = jax.devices()[0]
+    indptr = jax.device_put(jnp.asarray(indptr_np), dev)
+    indices = jax.device_put(jnp.asarray(indices_np), dev)
+
+    @jax.jit
+    def run(seeds, key):
+        n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key)
+        edges = sum(l.edge_count.astype(jnp.int32) for l in layers)
+        return n_id, edges
+
+    rng = np.random.default_rng(1)
+    key = jax.random.key(0)
+
+    # warmup (compile)
+    seeds = jnp.asarray(rng.integers(0, n_nodes, batch, dtype=np.int32))
+    for i in range(3):
+        n_id, edges = run(seeds, jax.random.fold_in(key, 1000 + i))
+    jax.block_until_ready(n_id)
+
+    total_edges = 0
+    t0 = time.perf_counter()
+    for i in range(batches):
+        seeds = jnp.asarray(rng.integers(0, n_nodes, batch, dtype=np.int32))
+        n_id, edges = run(seeds, jax.random.fold_in(key, i))
+        total_edges += int(edges)
+    jax.block_until_ready(n_id)
+    dt = time.perf_counter() - t0
+
+    seps = total_edges / dt
+    print(json.dumps({
+        "metric": "sampled-edges/sec (ogbn-products-scale, fanout [15,10,5], batch 1024)",
+        "value": round(seps, 1),
+        "unit": "edges/s",
+        "vs_baseline": round(seps / BASELINE_SEPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

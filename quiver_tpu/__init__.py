@@ -1,0 +1,66 @@
+"""quiver_tpu — a TPU-native graph-learning data framework.
+
+Re-implements the *capabilities* of torch-quiver (GPU-accelerated GNN
+sampling + tiered feature collection; reference public API at
+srcs/python/quiver/__init__.py:1-17) with a JAX/XLA/Pallas-first design:
+
+- graph sampling   -> static-shape, key-threaded samplers (Pallas reservoir
+                      kernel on TPU; jnp reference implementation as oracle)
+- feature storage  -> HBM cache + host tier, replicated or GSPMD-sharded
+                      over a `jax.sharding.Mesh` (the ICI generalization of
+                      the reference's NVLink "p2p clique")
+- multi-host comm  -> XLA collectives (`all_to_all`/`psum`) over ICI/DCN
+                      instead of a hand-rolled NCCL wrapper
+"""
+
+__version__ = "0.1.0"
+
+from .utils import (
+    CSRTopo,
+    parse_size,
+    reindex_by_config,
+    reindex_feature,
+    Topo,
+    init_p2p,
+)
+from .feature import Feature, DeviceConfig, DistFeature, PartitionInfo
+from .shard_tensor import ShardTensor, ShardTensorConfig
+from .pyg import GraphSageSampler, MixedGraphSageSampler, SampleJob
+from .comm import TpuComm, HostRankTable, get_comm_id
+from .partition import (
+    quiver_partition_feature,
+    load_quiver_feature_partition,
+    partition_feature_without_replication,
+)
+
+# torch-quiver compatible aliases (reference __init__.py exports these names)
+p2pCliqueTopo = Topo
+NcclComm = TpuComm
+getNcclId = get_comm_id
+
+__all__ = [
+    "CSRTopo",
+    "parse_size",
+    "reindex_by_config",
+    "reindex_feature",
+    "Topo",
+    "p2pCliqueTopo",
+    "init_p2p",
+    "Feature",
+    "DeviceConfig",
+    "DistFeature",
+    "PartitionInfo",
+    "ShardTensor",
+    "ShardTensorConfig",
+    "GraphSageSampler",
+    "MixedGraphSageSampler",
+    "SampleJob",
+    "TpuComm",
+    "NcclComm",
+    "HostRankTable",
+    "get_comm_id",
+    "getNcclId",
+    "quiver_partition_feature",
+    "load_quiver_feature_partition",
+    "partition_feature_without_replication",
+]

@@ -1,0 +1,4 @@
+from .sage import SAGEConv, GraphSAGE
+from .gat import GATConv, GAT
+
+__all__ = ["SAGEConv", "GraphSAGE", "GATConv", "GAT"]

@@ -1,0 +1,91 @@
+"""GAT in flax over the masked layer format (BASELINE.json configs[4]:
+"GAT on ogbn-products with attention-weighted neighbor sampling").
+
+Edge softmax is a masked segment-softmax: invalid (-1) edges get -inf
+logits, so padding never leaks attention mass.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def segment_softmax(logits: jax.Array, segment_ids: jax.Array,
+                    num_segments: int, valid: jax.Array) -> jax.Array:
+    """Softmax over edges grouped by target segment, masked."""
+    logits = jnp.where(valid, logits, NEG_INF)
+    seg_max = jax.ops.segment_max(logits, segment_ids,
+                                  num_segments=num_segments)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    shifted = jnp.where(valid, logits - seg_max[segment_ids], NEG_INF)
+    expd = jnp.where(valid, jnp.exp(shifted), 0.0)
+    denom = jax.ops.segment_sum(expd, segment_ids, num_segments=num_segments)
+    return expd / jnp.maximum(denom[segment_ids], 1e-16)
+
+
+class GATConv(nn.Module):
+    out_dim: int
+    heads: int = 1
+    concat: bool = True
+    negative_slope: float = 0.2
+
+    @nn.compact
+    def __call__(self, x_src, x_dst, edge_index):
+        h, f = self.heads, self.out_dim
+        num_targets = x_dst.shape[0]
+        src, dst = edge_index[0], edge_index[1]
+        valid = (src >= 0) & (dst >= 0)
+        s = jnp.where(valid, src, 0)
+        d = jnp.where(valid, dst, 0)
+
+        w_src = nn.Dense(h * f, use_bias=False, name="lin_src")(x_src)
+        w_dst = nn.Dense(h * f, use_bias=False, name="lin_dst")(x_dst)
+        w_src = w_src.reshape(-1, h, f)
+        w_dst = w_dst.reshape(-1, h, f)
+
+        att_src = self.param("att_src", nn.initializers.glorot_uniform(),
+                             (h, f))
+        att_dst = self.param("att_dst", nn.initializers.glorot_uniform(),
+                             (h, f))
+        alpha_src = (w_src * att_src).sum(-1)        # [S, h]
+        alpha_dst = (w_dst * att_dst).sum(-1)        # [T, h]
+        logits = nn.leaky_relu(alpha_src[s] + alpha_dst[d],
+                               negative_slope=self.negative_slope)  # [E, h]
+
+        out = []
+        msgs = w_src[s]                              # [E, h, f]
+        for head in range(h):
+            a = segment_softmax(logits[:, head], d, num_targets, valid)
+            weighted = msgs[:, head, :] * a[:, None]
+            out.append(jax.ops.segment_sum(weighted, d,
+                                           num_segments=num_targets))
+        stacked = jnp.stack(out, axis=1)             # [T, h, f]
+        if self.concat:
+            return stacked.reshape(num_targets, h * f)
+        return stacked.mean(axis=1)
+
+
+class GAT(nn.Module):
+    hidden_dim: int
+    out_dim: int
+    num_layers: int
+    heads: int = 4
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, adjs, *, train: bool = False):
+        for i, adj in enumerate(adjs):
+            x_target = x[:adj.size[1]]
+            last = i == self.num_layers - 1
+            conv = GATConv(self.out_dim if last else self.hidden_dim,
+                           heads=1 if last else self.heads,
+                           concat=not last, name=f"conv{i}")
+            x = conv(x, x_target, adj.edge_index)
+            if not last:
+                x = nn.elu(x)
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return x

@@ -1,0 +1,158 @@
+"""ctypes loader for the native host sampling engine.
+
+Compiles ``cpu_sampler.cpp`` on first use (g++ -O3 -shared) and exposes
+numpy-facing wrappers. Falls back to a pure-numpy implementation when no
+compiler is available, so the package stays importable everywhere.
+
+Replaces the reference's torch C++ extension boundary for the CPU path
+(srcs/cpp/src/quiver/quiver.cpp:11-119) — ctypes instead of pybind11.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "cpu_sampler.cpp")
+_LIB_PATH = os.path.join(_HERE, "_cpu_sampler.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-pthread", _SRC, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _LIB_PATH
+    except (OSError, subprocess.SubprocessError):
+        # retry without -march=native (some toolchains lack it)
+        try:
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            return _LIB_PATH
+        except (OSError, subprocess.SubprocessError):
+            return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _LIB_PATH if os.path.exists(_LIB_PATH) else _build()
+        if path is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        lib.qt_sample_layer.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
+        lib.qt_sample_layer.restype = None
+        _lib = lib
+        return _lib
+
+
+def _ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def cpu_sample_layer(indptr: np.ndarray, indices: np.ndarray,
+                     seeds: np.ndarray, k: int, seed: int = 0,
+                     num_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Per seed: up to k distinct uniform neighbors. (-1 fill, counts)."""
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    seeds = np.ascontiguousarray(seeds, dtype=np.int32)
+    s = seeds.shape[0]
+    nbrs = np.empty((s, k), dtype=np.int32)
+    counts = np.empty((s,), dtype=np.int32)
+    lib = get_lib()
+    if lib is not None:
+        lib.qt_sample_layer(
+            _ptr(indptr, ctypes.c_int64), _ptr(indices, ctypes.c_int32),
+            _ptr(seeds, ctypes.c_int32), s, k, seed & (2 ** 64 - 1),
+            _ptr(nbrs, ctypes.c_int32), _ptr(counts, ctypes.c_int32),
+            num_threads)
+        return nbrs, counts
+    return _numpy_sample_layer(indptr, indices, seeds, k, seed)
+
+
+def _numpy_sample_layer(indptr, indices, seeds, k, seed):
+    rng = np.random.default_rng(seed)
+    s = seeds.shape[0]
+    nbrs = np.full((s, k), -1, dtype=np.int32)
+    counts = np.zeros((s,), dtype=np.int32)
+    for i, v in enumerate(seeds):
+        if v < 0:
+            continue
+        row = indices[indptr[v]:indptr[v + 1]]
+        c = min(len(row), k)
+        counts[i] = c
+        if c == len(row):
+            nbrs[i, :c] = row
+        else:
+            nbrs[i, :c] = rng.choice(row, size=c, replace=False)
+    return nbrs, counts
+
+
+def first_occurrence_unique(flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Unique values of ``flat`` (ignoring <0) in first-occurrence order,
+    plus a (sorted_vals, rank->local) pair for id translation."""
+    valid_pos = np.flatnonzero(flat >= 0)
+    vals, first_idx = np.unique(flat[valid_pos], return_index=True)
+    order = np.argsort(valid_pos[first_idx], kind="stable")
+    uniq = vals[order]
+    rank_to_local = np.empty(len(vals), dtype=np.int32)
+    rank_to_local[order] = np.arange(len(vals), dtype=np.int32)
+    return uniq, (vals, rank_to_local)
+
+
+def cpu_sample_multihop(indptr, indices, seeds: np.ndarray,
+                        sizes: Sequence[int], seed: int = 0,
+                        num_threads: int = 0
+                        ) -> Tuple[np.ndarray, List[np.ndarray], List[np.ndarray]]:
+    """Host mirror of the device multi-hop sampler: identical shapes
+    (static caps, -1 fill) so results interleave freely with device output.
+    """
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    cur = np.ascontiguousarray(seeds, dtype=np.int32)
+    rows, cols = [], []
+    for li, k in enumerate(sizes):
+        s = cur.shape[0]
+        nbrs, _counts = cpu_sample_layer(
+            indptr, indices, cur, k, seed=seed + li, num_threads=num_threads)
+        flat = np.concatenate([cur, nbrs.reshape(-1)])
+        uniq, (sorted_vals, rank_to_local) = first_occurrence_unique(flat)
+
+        nbr_flat = nbrs.reshape(-1)
+        valid = nbr_flat >= 0
+        col = np.full(s * k, -1, dtype=np.int32)
+        safe = np.where(valid, nbr_flat, sorted_vals[0] if len(sorted_vals)
+                        else 0)
+        if len(sorted_vals):
+            col_vals = rank_to_local[np.searchsorted(sorted_vals, safe)]
+            col[valid] = col_vals[valid]
+        row = np.where(valid, np.repeat(np.arange(s, dtype=np.int32), k), -1)
+        rows.append(row)
+        cols.append(col)
+
+        cap = s + s * k
+        nxt = np.full(cap, -1, dtype=np.int32)
+        nxt[:len(uniq)] = uniq
+        cur = nxt
+    return cur, rows, cols

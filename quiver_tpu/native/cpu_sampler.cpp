@@ -1,0 +1,110 @@
+// Native host-side neighbor sampling engine.
+//
+// TPU-native equivalent of the reference CPU sampling engine
+// quiver<T,CPU> (srcs/cpp/include/quiver/quiver.cpu.hpp:30-103): parallel
+// per-seed uniform without-replacement neighbor sampling over CSR. Feeds
+// the hybrid host+device sampling path (MixedGraphSageSampler) while the
+// TPU runs the jitted device sampler.
+//
+// Design differences from the reference: no libtorch/at::parallel_for
+// dependency (plain std::thread), partial Fisher-Yates with an O(k) write
+// log instead of std::sample (same distribution, no per-row O(deg) temp),
+// splitmix64 counter RNG keyed by (seed, row) for reproducibility.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t &state) {
+    uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+void sample_range(const int64_t *indptr, const int32_t *indices,
+                  const int32_t *seeds, int64_t lo, int64_t hi, int32_t k,
+                  uint64_t seed, int32_t *out_nbrs, int32_t *out_counts) {
+    std::vector<int64_t> pos(k), val(k);
+    for (int64_t i = lo; i < hi; ++i) {
+        int32_t *out = out_nbrs + i * k;
+        const int32_t v = seeds[i];
+        if (v < 0) {
+            out_counts[i] = 0;
+            std::fill(out, out + k, -1);
+            continue;
+        }
+        const int64_t row_start = indptr[v];
+        const int64_t deg = indptr[v + 1] - row_start;
+        const int64_t c = std::min<int64_t>(deg, k);
+        out_counts[i] = static_cast<int32_t>(c);
+        if (deg <= k) {
+            for (int64_t t = 0; t < deg; ++t) out[t] = indices[row_start + t];
+            std::fill(out + deg, out + k, -1);
+            continue;
+        }
+        uint64_t state = seed ^ (0xD1B54A32D192ED03ULL * (uint64_t)(v + 1));
+        int written = 0;
+        for (int32_t t = 0; t < k; ++t) {
+            const int64_t j =
+                t + (int64_t)(splitmix64(state) % (uint64_t)(deg - t));
+            int64_t a_j = j, a_t = t;
+            for (int w = written - 1; w >= 0; --w)
+                if (pos[w] == j) { a_j = val[w]; break; }
+            for (int w = written - 1; w >= 0; --w)
+                if (pos[w] == t) { a_t = val[w]; break; }
+            out[t] = indices[row_start + a_j];
+            pos[written] = j;
+            val[written] = a_t;
+            ++written;
+        }
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Sample up to k neighbors (uniform, without replacement) per seed.
+// out_nbrs: [num_seeds * k] (-1 fill), out_counts: [num_seeds].
+void qt_sample_layer(const int64_t *indptr, const int32_t *indices,
+                     const int32_t *seeds, int64_t num_seeds, int32_t k,
+                     uint64_t seed, int32_t *out_nbrs, int32_t *out_counts,
+                     int32_t num_threads) {
+    if (num_seeds == 0) return;
+    int32_t nt = num_threads > 0
+                     ? num_threads
+                     : (int32_t)std::thread::hardware_concurrency();
+    nt = std::max(1, std::min<int32_t>(nt, (int32_t)num_seeds));
+    if (nt == 1) {
+        sample_range(indptr, indices, seeds, 0, num_seeds, k, seed, out_nbrs,
+                     out_counts);
+        return;
+    }
+    std::vector<std::thread> threads;
+    const int64_t chunk = (num_seeds + nt - 1) / nt;
+    for (int32_t t = 0; t < nt; ++t) {
+        const int64_t lo = t * chunk;
+        const int64_t hi = std::min(num_seeds, lo + chunk);
+        if (lo >= hi) break;
+        threads.emplace_back(sample_range, indptr, indices, seeds, lo, hi, k,
+                             seed, out_nbrs, out_counts);
+    }
+    for (auto &th : threads) th.join();
+}
+
+// Full-row degree lookup (== quiver::degree, quiver.cpu.hpp).
+void qt_degree(const int64_t *indptr, const int32_t *seeds, int64_t num_seeds,
+               int32_t *out_deg) {
+    for (int64_t i = 0; i < num_seeds; ++i) {
+        const int32_t v = seeds[i];
+        out_deg[i] =
+            v < 0 ? 0 : static_cast<int32_t>(indptr[v + 1] - indptr[v]);
+    }
+}
+
+}  // extern "C"

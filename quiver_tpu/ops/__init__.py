@@ -1,0 +1,17 @@
+from .sample import (
+    sample_layer,
+    compact_layer,
+    sample_prob_step,
+    sample_prob,
+    LayerSample,
+)
+from .sample_multihop import sample_multihop
+
+__all__ = [
+    "sample_layer",
+    "compact_layer",
+    "sample_prob_step",
+    "sample_prob",
+    "sample_multihop",
+    "LayerSample",
+]

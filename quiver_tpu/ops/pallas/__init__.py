@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the hot paths.
+
+Kernels land here as they replace the jnp reference implementations in
+``quiver_tpu.ops`` (which remain the correctness oracles):
+
+- sample_kernel: warp-per-seed equivalent of CSRRowWiseSampleKernel
+- gather_kernel: sparse feature row gather (quiver_tensor_gather)
+"""
+
+__all__ = []

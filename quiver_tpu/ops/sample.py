@@ -1,0 +1,211 @@
+"""Static-shape neighbor sampling + layer compaction (jnp reference impl).
+
+This is the TPU-native redesign of the reference's CUDA sampling stack:
+
+- ``sample_layer``   <- warp-per-row reservoir kernel ``CSRRowWiseSampleKernel``
+  (cuda_random.cu.hpp:7-69) + the orchestration in ``TorchQuiver::sample_kernel``
+  (quiver_sample.cu:134-200). Same contract — per seed, draw
+  ``min(degree, k)`` distinct neighbors uniformly without replacement — but
+  expressed as a vectorized partial Fisher–Yates over a fixed ``(bs, k)``
+  output with a validity count, because XLA requires static shapes (the
+  reference allocates a dynamic ``tot``-sized buffer instead).
+
+- ``compact_layer``  <- the device ordered hashtable + prefix-sum compaction
+  (``reindex_single``/``FillWithDuplicates``, quiver_sample.cu:202-357,
+  reindex.cu.hpp:20-183). TPUs have no atomics-friendly hashtable, so
+  uniqueness is computed by stable sort + run-length flags + segment-min of
+  first-occurrence positions, preserving the reference's first-occurrence
+  ordering guarantee (seeds come first in ``n_id``).
+
+- ``sample_prob``    <- ``cal_next`` probability propagation
+  (cuda_random.cu.hpp:71-104, sage_sampler.py:149-157) as pure segment ops.
+
+All functions are jit-compatible: static ``k``/capacities, explicit PRNG
+keys, masked invalid slots (id == -1).
+
+This module doubles as the correctness oracle for the Pallas kernels in
+``quiver_tpu.ops.pallas``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LayerSample(NamedTuple):
+    """One sampled hop, fixed shapes.
+
+    n_id:     [cap] unique node ids (first-occurrence order; seeds first;
+              -1 fill past ``n_count``)
+    n_count:  [] number of valid entries in ``n_id``
+    row:      [num_seeds*k] local (compacted) index of the seed of each
+              sampled edge; -1 fill
+    col:      [num_seeds*k] local index of the sampled neighbor; -1 fill
+    edge_count: [] number of valid sampled edges
+    """
+
+    n_id: jax.Array
+    n_count: jax.Array
+    row: jax.Array
+    col: jax.Array
+    edge_count: jax.Array
+
+
+def _fisher_yates_rows(key: jax.Array, deg: jax.Array, k: int) -> jax.Array:
+    """Per row, draw ``min(deg, k)`` distinct positions in ``[0, deg)``.
+
+    Vectorized partial Fisher–Yates: a virtual array ``a = [0..deg)`` per
+    row; step i swaps ``a[i]`` with ``a[j]``, ``j ~ U[i, deg)``, and emits
+    ``a[j]``. Only the <=k written entries are materialized (a tiny write
+    log), so cost is O(bs * k^2) independent of degree — the same trick the
+    reference's warp reservoir achieves with atomics, minus the atomics.
+
+    Returns positions [bs, k]; entries at slot i >= min(deg, k) are
+    meaningless and must be masked by the caller.
+    """
+    bs = deg.shape[0]
+    steps = jnp.arange(k, dtype=jnp.int32)
+
+    def lookup(pos_log, val_log, x):
+        # virtual read a[x]: last write wins; unwritten -> x itself
+        match = pos_log == x[:, None]                       # [bs, k]
+        last = jnp.max(jnp.where(match, steps[None, :], -1), axis=1)
+        logged = jnp.take_along_axis(
+            val_log, jnp.maximum(last, 0)[:, None], axis=1)[:, 0]
+        return jnp.where(last >= 0, logged, x)
+
+    def body(carry, xs):
+        pos_log, val_log = carry
+        i, subkey = xs
+        span = jnp.maximum(deg - i, 1)
+        j = i + jax.random.randint(subkey, (bs,), 0, span).astype(deg.dtype)
+        a_j = lookup(pos_log, val_log, j)
+        a_i = lookup(pos_log, val_log, jnp.full((bs,), i, dtype=deg.dtype))
+        pos_log = jax.lax.dynamic_update_slice_in_dim(
+            pos_log, j[:, None], i, axis=1)
+        val_log = jax.lax.dynamic_update_slice_in_dim(
+            val_log, a_i[:, None], i, axis=1)
+        return (pos_log, val_log), a_j
+
+    pos_log = jnp.full((bs, k), -1, dtype=deg.dtype)
+    val_log = jnp.zeros((bs, k), dtype=deg.dtype)
+    keys = jax.random.split(key, k)
+    (_, _), picks = jax.lax.scan(
+        body, (pos_log, val_log), (steps, keys))
+    return jnp.transpose(picks)                              # [bs, k]
+
+
+def sample_layer(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
+                 k: int, key: jax.Array):
+    """Sample up to ``k`` distinct neighbors for each seed.
+
+    seeds may contain -1 fill (masked rows). Returns
+    (neighbors [bs, k] with -1 fill, counts [bs]).
+    """
+    n = indptr.shape[0] - 1
+    e = indices.shape[0]
+    valid = seeds >= 0
+    safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
+    start = indptr[safe]
+    deg = jnp.where(valid, indptr[safe + 1] - start, 0).astype(jnp.int32)
+    counts = jnp.minimum(deg, k)
+    picks = _fisher_yates_rows(key, deg, k)
+    gather = jnp.clip(start[:, None] + picks.astype(indptr.dtype), 0, e - 1)
+    nbrs = indices[gather].astype(jnp.int32)
+    mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
+    nbrs = jnp.where(mask, nbrs, -1)
+    return nbrs, counts
+
+
+def compact_layer(seeds: jax.Array, nbrs: jax.Array) -> LayerSample:
+    """Deduplicate ``concat(seeds, nbrs)`` preserving first-occurrence order
+    and emit the layer's bipartite COO in local (compacted) ids.
+
+    seeds: [s] int32, -1 fill allowed. nbrs: [s, k] int32, -1 fill.
+    Output capacity is the static ``s + s*k``.
+    """
+    s, k = nbrs.shape
+    cap = s + s * k
+    ids = jnp.concatenate([seeds, nbrs.reshape(-1)]).astype(jnp.int32)
+    valid = ids >= 0
+    sent = jnp.iinfo(jnp.int32).max
+    keyed = jnp.where(valid, ids, sent)
+    # positions drive first-occurrence order; invalid entries pushed last
+    pos = jnp.where(valid, jnp.arange(cap, dtype=jnp.int32), cap)
+
+    order = jnp.argsort(keyed, stable=True)
+    sorted_ids = keyed[order]
+    sorted_pos = pos[order]
+    is_run_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]])
+    seg = jnp.cumsum(is_run_start) - 1                       # [cap]
+    n_count = jnp.sum(is_run_start & (sorted_ids != sent)).astype(jnp.int32)
+
+    # per unique value: its id and its first-occurrence position
+    uniq_val = jax.ops.segment_min(sorted_ids, seg, num_segments=cap)
+    uniq_pos = jax.ops.segment_min(sorted_pos, seg, num_segments=cap)
+
+    # order uniques by first occurrence -> n_id; invert for local-id lookup
+    perm = jnp.argsort(uniq_pos, stable=True)
+    n_id = jnp.where(jnp.arange(cap, dtype=jnp.int32) < n_count,
+                     uniq_val[perm], -1)
+    local_of_seg = jnp.zeros((cap,), jnp.int32).at[perm].set(
+        jnp.arange(cap, dtype=jnp.int32))
+
+    # segment of every original element (scatter back through the sort)
+    seg_of_elem = jnp.zeros((cap,), jnp.int32).at[order].set(
+        seg.astype(jnp.int32))
+    local_ids = local_of_seg[seg_of_elem]                    # [cap]
+
+    nbr_valid = valid[s:]
+    col = jnp.where(nbr_valid, local_ids[s:], -1)
+    row = jnp.where(
+        nbr_valid,
+        jnp.repeat(jnp.arange(s, dtype=jnp.int32), k),
+        -1,
+    )
+    edge_count = jnp.sum(nbr_valid).astype(jnp.int32)
+    return LayerSample(n_id=n_id, n_count=n_count, row=row, col=col,
+                       edge_count=edge_count)
+
+
+def sample_prob_step(indptr: jax.Array, indices: jax.Array,
+                     last_prob: jax.Array, k: int,
+                     row_ids: jax.Array | None = None) -> jax.Array:
+    """One hop of sampled-probability propagation (== ``cal_next``,
+    cuda_random.cu.hpp:71-104): for each node v with neighbors u,
+
+        cur[v] = 1 - (1 - last[v]) * prod_u (1 - last[u] * min(1, k/deg(u)))
+
+    and cur[v] = 0 when deg(v) == 0 (reference quirk kept for parity).
+    """
+    n = indptr.shape[0] - 1
+    deg = (indptr[1:] - indptr[:-1]).astype(jnp.float32)
+    frac = jnp.where(deg > 0, jnp.minimum(1.0, k / jnp.maximum(deg, 1.0)), 0.0)
+    skip = 1.0 - last_prob * frac                            # per node
+    if row_ids is None:
+        row_ids = edge_rows(indptr, indices.shape[0])
+    acc = jax.ops.segment_prod(skip[indices], row_ids, num_segments=n)
+    cur = 1.0 - (1.0 - last_prob) * acc
+    return jnp.where(deg > 0, cur, 0.0)
+
+
+def sample_prob(indptr: jax.Array, indices: jax.Array, train_idx: jax.Array,
+                sizes, total_node_count: int) -> jax.Array:
+    """k-hop access probability from train seeds (== ``sample_prob``,
+    sage_sampler.py:149-157). Feeds cache ordering and partitioning."""
+    prob = jnp.zeros((total_node_count,), jnp.float32).at[train_idx].set(1.0)
+    rows = edge_rows(indptr, indices.shape[0])
+    for k in sizes:
+        prob = sample_prob_step(indptr, indices, prob, k, row_ids=rows)
+    return prob
+
+
+def edge_rows(indptr: jax.Array, edge_count: int) -> jax.Array:
+    """Row id of every CSR slot: searchsorted-based expansion of indptr."""
+    return (jnp.searchsorted(
+        indptr, jnp.arange(edge_count, dtype=indptr.dtype), side="right") - 1
+    ).astype(jnp.int32)

@@ -1,0 +1,29 @@
+"""Fused multi-hop sampling: the whole k-hop frontier expansion as one
+traceable function (used by GraphSageSampler and by the end-to-end
+jitted training step)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .sample import LayerSample, compact_layer, sample_layer
+
+
+def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
+                    sizes: Sequence[int], key: jax.Array
+                    ) -> Tuple[jax.Array, List[LayerSample]]:
+    """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
+    ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
+    sampling order (innermost target hop first)."""
+    cur = seeds.astype(jnp.int32)
+    layers: List[LayerSample] = []
+    for i, k in enumerate(sizes):
+        sub = jax.random.fold_in(key, i)
+        nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
+        layer = compact_layer(cur, nbrs)
+        layers.append(layer)
+        cur = layer.n_id
+    return cur, layers

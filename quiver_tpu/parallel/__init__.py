@@ -1,0 +1,17 @@
+from .mesh import make_mesh, replicated, row_sharded
+from .train import (
+    TrainState,
+    build_train_step,
+    build_e2e_train_step,
+    cross_entropy_logits,
+)
+
+__all__ = [
+    "make_mesh",
+    "replicated",
+    "row_sharded",
+    "TrainState",
+    "build_train_step",
+    "build_e2e_train_step",
+    "cross_entropy_logits",
+]

@@ -1,0 +1,37 @@
+"""Mesh construction helpers.
+
+The reference's device topology is probed NVLink cliques + NCCL ranks
+(survey §2.1 P2, §2.2 N8/N9); the TPU-native equivalent is just a named
+`jax.sharding.Mesh` whose axes carry the parallelism meaning:
+
+- ``data``  : data parallelism (per-chip seed batches; grads psum)
+- ``cache`` : feature-store row sharding (the "p2p clique" generalization)
+
+Both can map onto the same physical axis for small meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_names: Sequence[str] = ("data",),
+              shape: Optional[Sequence[int]] = None,
+              devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    arr = np.array(devices).reshape(tuple(shape))
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def row_sharded(mesh: Mesh, axis: str) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

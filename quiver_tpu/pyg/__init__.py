@@ -1,0 +1,8 @@
+from .sage_sampler import (
+    Adj,
+    GraphSageSampler,
+    MixedGraphSageSampler,
+    SampleJob,
+)
+
+__all__ = ["Adj", "GraphSageSampler", "MixedGraphSageSampler", "SampleJob"]

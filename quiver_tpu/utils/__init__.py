@@ -1,0 +1,16 @@
+from .csr import CSRTopo, get_csr_from_coo, index_dtype_for
+from .sizes import parse_size, UNITS
+from .reorder import reindex_by_config, reindex_feature
+from .topo import Topo, init_p2p
+
+__all__ = [
+    "CSRTopo",
+    "get_csr_from_coo",
+    "index_dtype_for",
+    "parse_size",
+    "UNITS",
+    "reindex_by_config",
+    "reindex_feature",
+    "Topo",
+    "init_p2p",
+]

@@ -1,0 +1,155 @@
+"""CSR graph topology container.
+
+TPU-native equivalent of the reference ``CSRTopo`` (utils.py:120-226) and
+``get_csr_from_coo`` (utils.py:110-117). Differences by design:
+
+- arrays are jnp (device-resident) pytree leaves, not torch CPU tensors;
+  COO->CSR runs on-device via stable argsort + searchsorted (no scipy).
+- node ids default to int32 (TPU-preferred); ``indptr`` widens to int64
+  only when edge_count >= 2**31 (mixed-width CSR, survey §7.3.7).
+- isolated tail nodes are kept when ``node_count`` is passed explicitly
+  (the reference silently drops them, a known quirk — survey §7.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+def index_dtype_for(count: int):
+    """Smallest TPU-friendly integer dtype that can index ``count`` items."""
+    return jnp.int32 if count <= INT32_MAX else jnp.int64
+
+
+def _as_jnp(x, dtype=None):
+    if x is None:
+        return None
+    arr = jnp.asarray(x)
+    if dtype is not None and arr.dtype != dtype:
+        arr = arr.astype(dtype)
+    return arr
+
+
+def get_csr_from_coo(edge_index, node_count: Optional[int] = None):
+    """COO ``edge_index`` (2, E) -> (indptr, indices, eid).
+
+    ``eid[j]`` is the original COO position of the edge stored at CSR slot
+    ``j`` (the reference keeps the same mapping via scipy's csr ``.data``).
+    """
+    edge_index = jnp.asarray(edge_index)
+    row, col = edge_index[0], edge_index[1]
+    e = int(row.shape[0])
+    if node_count is None:
+        if e == 0:
+            node_count = 0
+        else:
+            node_count = int(jnp.maximum(row.max(), col.max())) + 1
+    node_dtype = index_dtype_for(max(node_count, 1))
+    ptr_dtype = index_dtype_for(max(e, 1))
+
+    order = jnp.argsort(row, stable=True)
+    indices = col[order].astype(node_dtype)
+    eid = order.astype(ptr_dtype)
+    row_sorted = row[order]
+    indptr = jnp.searchsorted(
+        row_sorted, jnp.arange(node_count + 1, dtype=row_sorted.dtype)
+    ).astype(ptr_dtype)
+    return indptr, indices, eid
+
+
+@jax.tree_util.register_pytree_node_class
+class CSRTopo:
+    """Canonical graph topology: CSR ``indptr``/``indices`` (+ optional
+    ``eid`` edge-id map and ``feature_order`` hot-cache permutation).
+
+    Mirrors the API of the reference ``CSRTopo`` (utils.py:120-226):
+    ``indptr``/``indices``/``eid``/``feature_order`` properties, ``degree``,
+    ``node_count``, ``edge_count``. ``share_memory_`` is a no-op on TPU
+    (one process owns all local chips; no cross-process IPC needed).
+    """
+
+    def __init__(self, edge_index=None, indptr=None, indices=None, eid=None,
+                 node_count: Optional[int] = None):
+        if edge_index is not None:
+            self._indptr, self._indices, self._eid = get_csr_from_coo(
+                edge_index, node_count)
+        elif indptr is not None and indices is not None:
+            e = int(np.asarray(jnp.shape(indices))[0]) if hasattr(indices, "shape") else len(indices)
+            ptr_dtype = index_dtype_for(max(e, 1))
+            self._indptr = _as_jnp(indptr, ptr_dtype)
+            n = int(self._indptr.shape[0]) - 1
+            self._indices = _as_jnp(indices, index_dtype_for(max(n, 1)))
+            self._eid = _as_jnp(eid, ptr_dtype)
+        else:
+            raise ValueError("provide either edge_index or indptr+indices")
+        self._feature_order = None
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        leaves = (self._indptr, self._indices, self._eid, self._feature_order)
+        return leaves, None
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        obj = cls.__new__(cls)
+        obj._indptr, obj._indices, obj._eid, obj._feature_order = leaves
+        return obj
+
+    # -- accessors ----------------------------------------------------------
+    @property
+    def indptr(self):
+        return self._indptr
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def eid(self):
+        return self._eid
+
+    @property
+    def feature_order(self):
+        return self._feature_order
+
+    @feature_order.setter
+    def feature_order(self, order):
+        self._feature_order = None if order is None else jnp.asarray(order)
+
+    @property
+    def degree(self):
+        return self._indptr[1:] - self._indptr[:-1]
+
+    @property
+    def node_count(self) -> int:
+        return int(self._indptr.shape[0]) - 1
+
+    @property
+    def edge_count(self) -> int:
+        return int(self._indices.shape[0])
+
+    def share_memory_(self):
+        return self
+
+    def device_put(self, sharding_or_device=None):
+        """Place topology arrays (HBM by default; pass a Sharding with
+        ``memory_kind='pinned_host'`` for the host/zero-copy tier)."""
+        put = lambda x: None if x is None else jax.device_put(x, sharding_or_device)
+        obj = CSRTopo.__new__(CSRTopo)
+        obj._indptr = put(self._indptr)
+        obj._indices = put(self._indices)
+        obj._eid = put(self._eid)
+        obj._feature_order = put(self._feature_order)
+        return obj
+
+    def __repr__(self):
+        return (f"CSRTopo(node_count={self.node_count}, "
+                f"edge_count={self.edge_count}, "
+                f"indptr_dtype={self._indptr.dtype}, "
+                f"indices_dtype={self._indices.dtype})")

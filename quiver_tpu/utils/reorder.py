@@ -1,0 +1,40 @@
+"""Degree-descending hot-order reindexing for cache placement.
+
+Capability parity with the reference ``reindex_by_config``/``reindex_feature``
+(utils.py:230-248): sort nodes by degree descending, randomly shuffle the
+cached (hot) prefix for load balance, and return the permuted feature plus
+the ``new_order`` map (old id -> new row).
+
+Host-side preprocessing: runs in numpy (feature tensors may exceed HBM at
+this stage; the permuted result is what gets placed on device).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def reindex_by_config(adj_csr, graph_feature, gpu_portion: float, seed: int = 0):
+    """Returns (permuted_feature, new_order).
+
+    ``prev_order[i]`` = old node id stored at new row i (degree-descending,
+    hot prefix shuffled). ``new_order[old_id]`` = new row of ``old_id``.
+    """
+    degree = np.asarray(adj_csr.degree)
+    node_count = degree.shape[0]
+    prev_order = np.argsort(-degree, kind="stable")
+    hot = int(node_count * gpu_portion)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(hot)
+    prev_order[:hot] = prev_order[perm]
+    new_order = np.empty(node_count, dtype=np.int64)
+    new_order[prev_order] = np.arange(node_count, dtype=np.int64)
+    feature = None
+    if graph_feature is not None:
+        feature = np.asarray(graph_feature)[prev_order]
+    return feature, new_order
+
+
+def reindex_feature(graph: "CSRTopo", feature, ratio: float, seed: int = 0):
+    feature, new_order = reindex_by_config(graph, feature, ratio, seed=seed)
+    return feature, new_order

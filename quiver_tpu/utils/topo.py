@@ -1,0 +1,81 @@
+"""Device interconnect topology.
+
+TPU-native replacement for the reference's P2P clique discovery
+(``Topo``/``find_cliques``/``color_mat``, utils.py:8-107, and the CUDA
+``init_p2p``/``can_device_access_peer`` probe, quiver_feature.cu:363-413).
+
+On TPU there is nothing to probe: every chip within a slice is connected by
+ICI (the generalization of an NVLink clique), and slices are joined by DCN.
+A "clique" is therefore a slice; peer access inside it is always true. The
+class keeps the reference's query API (``get_clique_id``, ``info``,
+``p2p_clique``) so user code ports over unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+
+def _slice_key(device) -> tuple:
+    return (device.process_index, getattr(device, "slice_index", 0))
+
+
+class Topo:
+    """ICI clique topology over a list of jax devices (defaults to all)."""
+
+    def __init__(self, device_list: Optional[Sequence] = None):
+        if device_list is None:
+            devices = list(jax.devices())
+        elif device_list and isinstance(device_list[0], int):
+            all_devices = jax.devices()
+            devices = [all_devices[i] for i in device_list]
+        else:
+            devices = list(device_list)
+        self.devices = devices
+        groups = {}
+        for d in devices:
+            groups.setdefault(_slice_key(d), []).append(d)
+        self.cliques: List[List] = list(groups.values())
+        self._clique_of = {}
+        for cid, clique in enumerate(self.cliques):
+            for d in clique:
+                self._clique_of[d.id] = cid
+
+    @property
+    def Topo_Dict(self):
+        return {cid: [d.id for d in c] for cid, c in enumerate(self.cliques)}
+
+    def get_clique_id(self, device) -> int:
+        device_id = device if isinstance(device, int) else device.id
+        return self._clique_of[device_id]
+
+    def p2p_clique(self, clique_id: int) -> List[int]:
+        return [d.id for d in self.cliques[clique_id]]
+
+    def info(self) -> str:
+        lines = ["ICI topology:"]
+        for cid, clique in enumerate(self.cliques):
+            ids = ", ".join(str(d.id) for d in clique)
+            lines.append(f"  clique {cid} (ICI-connected): devices [{ids}]")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+def init_p2p(device_list: Optional[Sequence[int]] = None) -> Topo:
+    """API-compat shim for the reference ``quiver.init_p2p`` (utils.py:251-257).
+
+    TPU ICI links need no enabling; this just returns the discovered
+    topology so callers can inspect cliques.
+    """
+    return Topo(device_list)
+
+
+def can_device_access_peer(src: int, dst: int) -> bool:
+    topo = Topo()
+    try:
+        return topo.get_clique_id(src) == topo.get_clique_id(dst)
+    except KeyError:
+        return False

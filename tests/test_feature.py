@@ -1,0 +1,240 @@
+"""Feature store tests: tier splitting, policies, id indirection,
+distributed dispatch/exchange (mirrors reference test_features.py /
+test_shard_tensor.py / test_comm.py coverage, but asserted)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import quiver_tpu as qv
+
+
+def make_feature(n=100, dim=16, cache_frac=0.5, policy="device_replicate",
+                 csr_topo=None, mesh=None, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    feat = rng.standard_normal((n, dim)).astype(dtype)
+    budget = int(n * cache_frac) * dim * feat.dtype.itemsize
+    f = qv.Feature(rank=0, device_list=[0], device_cache_size=budget,
+                   cache_policy=policy, csr_topo=csr_topo, mesh=mesh)
+    f.from_cpu_tensor(feat)
+    return f, feat
+
+
+class TestShardTensor:
+    def test_two_tier_gather(self, rng):
+        data = rng.standard_normal((60, 8)).astype(np.float32)
+        st = qv.ShardTensor(0)
+        st.append(data[:40], 0)     # device tier
+        st.append(data[40:], -1)    # host tier
+        ids = rng.integers(0, 60, 33)
+        np.testing.assert_allclose(
+            np.asarray(st[jnp.asarray(ids)]), data[ids], rtol=1e-6)
+        assert st.shape == (60, 8)
+        assert st.size(0) == 60
+
+    def test_bf16_supported(self, rng):
+        data = rng.standard_normal((10, 4)).astype(jnp.bfloat16)
+        st = qv.ShardTensor(0)
+        st.append(data, 0)
+        out = st[jnp.arange(10)]
+        assert out.dtype == jnp.bfloat16
+
+    def test_ipc_roundtrip(self, rng):
+        data = rng.standard_normal((20, 4)).astype(np.float32)
+        st = qv.ShardTensor(0)
+        st.append(data, 0)
+        st2 = qv.ShardTensor.new_from_share_ipc(st.share_ipc())
+        np.testing.assert_allclose(
+            np.asarray(st2[jnp.arange(20)]), data, rtol=1e-6)
+
+
+class TestFeature:
+    def test_all_cached_lookup(self):
+        f, feat = make_feature(cache_frac=1.0)
+        ids = np.array([0, 5, 99, 5])
+        np.testing.assert_allclose(
+            np.asarray(f[jnp.asarray(ids)]), feat[ids], rtol=1e-6)
+
+    def test_two_tier_lookup(self):
+        f, feat = make_feature(cache_frac=0.3)
+        assert f.cache_rows == 30
+        assert f.host_part is not None
+        ids = np.array([0, 29, 30, 99])
+        np.testing.assert_allclose(
+            np.asarray(f[jnp.asarray(ids)]), feat[ids], rtol=1e-6)
+
+    def test_degree_ordered_cache(self, rng):
+        # hottest (highest-degree) nodes must land in the cached tier
+        n, dim = 50, 4
+        deg = rng.integers(1, 20, n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]))
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        budget = 10 * dim * 4
+        f = qv.Feature(device_cache_size=budget, csr_topo=topo)
+        f.from_cpu_tensor(feat)
+        order = np.asarray(jax.device_get(f.feature_order))
+        top10 = np.argsort(-deg, kind="stable")[:10]
+        # every top-degree node's storage row is inside the cache
+        assert (order[top10] < f.cache_rows).all()
+        ids = rng.integers(0, n, 32)
+        np.testing.assert_allclose(
+            np.asarray(f[jnp.asarray(ids)]), feat[ids], rtol=1e-6)
+
+    def test_sharded_policy_on_mesh(self):
+        mesh = Mesh(np.array(jax.devices()), axis_names=("cache",))
+        f, feat = make_feature(n=128, cache_frac=1.0,
+                               policy="p2p_clique_replicate", mesh=mesh)
+        ids = np.array([0, 1, 64, 127, 3])
+        np.testing.assert_allclose(
+            np.asarray(f[jnp.asarray(ids)]), feat[ids], rtol=1e-6)
+        # actually sharded: 8 devices, 128 rows -> 16 rows per shard
+        shards = f.device_part.addressable_shards
+        assert len(shards) == 8
+        assert shards[0].data.shape[0] == 16
+
+    def test_from_mmap_parts(self, rng):
+        feat = rng.standard_normal((40, 8)).astype(np.float32)
+        cfg = qv.DeviceConfig([feat[:10], feat[10:20]], feat[20:])
+        f = qv.Feature()
+        f.from_mmap(None, cfg)
+        ids = np.array([0, 9, 10, 19, 20, 39])
+        np.testing.assert_allclose(
+            np.asarray(f[jnp.asarray(ids)]), feat[ids], rtol=1e-6)
+
+    def test_disk_tier(self, rng, tmp_path):
+        feat = rng.standard_normal((30, 4)).astype(np.float32)
+        disk = rng.standard_normal((10, 4)).astype(np.float32)
+        path = tmp_path / "disk.npy"
+        np.save(path, disk)
+        f, _ = make_feature(n=30, dim=4, cache_frac=1.0, seed=3)
+        feat = np.asarray(jax.device_get(f.device_part))
+        # ids >= 30 hit the disk tier through disk_map
+        f2 = qv.Feature(device_cache_size=30 * 16)
+        f2.from_cpu_tensor(feat)
+        f2.host_part = None
+        f2.set_mmap_file(str(path), np.arange(40) - 30)
+        ids = np.array([2, 35, 39])
+        got = np.asarray(f2[jnp.asarray(ids)])
+        np.testing.assert_allclose(got[0], feat[2], rtol=1e-6)
+        np.testing.assert_allclose(got[1], disk[5], rtol=1e-6)
+        np.testing.assert_allclose(got[2], disk[9], rtol=1e-6)
+
+    def test_size_dim_shape(self):
+        f, _ = make_feature(n=100, dim=16, cache_frac=0.5)
+        assert f.shape == (100, 16)
+        assert f.size(0) == 100
+        assert f.dim() == 16
+
+
+class TestPartitionInfo:
+    def test_dispatch(self):
+        g2h = np.array([0, 1, 0, 1, 0, 1])
+        info = qv.PartitionInfo(host=0, hosts=2, global2host=g2h)
+        ids, pos = info.dispatch(np.array([0, 1, 2, 3]))
+        # host0 owns globals 0,2,4 -> local rows 0,1,2
+        np.testing.assert_array_equal(ids[0], [0, 1])
+        np.testing.assert_array_equal(pos[0], [0, 2])
+        np.testing.assert_array_equal(ids[1], [0, 1])
+        np.testing.assert_array_equal(pos[1], [1, 3])
+
+    def test_replicated_resolved_locally(self):
+        g2h = np.array([0, 1, 1, 1])
+        info = qv.PartitionInfo(host=0, hosts=2, global2host=g2h,
+                                replicate=np.array([1]))
+        ids, pos = info.dispatch(np.array([1, 3]))
+        assert pos[0].tolist() == [0]       # global 1 answered locally
+        assert ids[0].tolist() == [1]       # tail row after 1 owned node
+        assert pos[1].tolist() == [1]
+
+
+class TestDistFeature:
+    def test_two_simulated_hosts(self, rng):
+        n, dim = 40, 8
+        full = rng.standard_normal((n, dim)).astype(np.float32)
+        g2h = (np.arange(n) % 2).astype(np.int32)
+        local0, local1 = full[g2h == 0], full[g2h == 1]
+
+        def make_local(part):
+            f = qv.Feature(device_cache_size=part.nbytes)
+            f.from_cpu_tensor(part)
+            return f
+
+        f0, f1 = make_local(local0), make_local(local1)
+        info = qv.PartitionInfo(host=0, hosts=2, global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=2, peers={1: f1})
+        dist = qv.DistFeature(f0, info, comm)
+        ids = rng.integers(0, n, 17)
+        np.testing.assert_allclose(
+            np.asarray(dist[ids]), full[ids], rtol=1e-6)
+
+
+class TestCommSPMD:
+    def test_exchange_over_mesh(self, rng):
+        # 8 virtual hosts exchange feature rows via all_to_all
+        h, rows, dim, cap = 8, 16, 4, 5
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        feat = rng.standard_normal((h * rows, dim)).astype(np.float32)
+        feat_sharded = jax.device_put(
+            jnp.asarray(feat),
+            jax.sharding.NamedSharding(mesh, P("host")))
+        req = rng.integers(0, rows, size=(h, h, cap)).astype(np.int32)
+        comm = qv.TpuComm(rank=0, world_size=h, mesh=mesh)
+        resp = np.asarray(comm.exchange_spmd(jnp.asarray(req), feat_sharded,
+                                             cap))
+        for s in range(h):
+            for d in range(h):
+                want = feat[d * rows + req[s, d]]
+                np.testing.assert_allclose(resp[s, d], want, rtol=1e-6)
+
+
+class TestSchedule:
+    def test_contention_free(self):
+        sizes = np.array([[0, 5, 3], [2, 0, 0], [9, 1, 0]])
+        steps = qv.comm.schedule(sizes)
+        seen = set()
+        for step in steps:
+            busy = set()
+            for src, dst in step:
+                assert src not in busy and dst not in busy
+                busy.update((src, dst))
+                seen.add((src, dst))
+        assert seen == {(0, 1), (0, 2), (1, 0), (2, 0), (2, 1)}
+
+
+class TestPartitioner:
+    def test_partition_covers_all_nodes(self, rng):
+        n = 1000
+        probs = [rng.random(n) for _ in range(4)]
+        res, _ = qv.partition_feature_without_replication(probs, 64)
+        allids = np.concatenate(res)
+        assert len(allids) == n
+        assert len(np.unique(allids)) == n  # no replication
+
+    def test_prefers_own_high_prob(self, rng):
+        # single chunk covering the whole graph: pure score-greedy split
+        n = 256
+        probs = [np.zeros(n), np.zeros(n)]
+        probs[0][:128] = 1.0   # partition 0 hot on first half
+        probs[1][128:] = 1.0
+        res, _ = qv.partition_feature_without_replication(probs, 128)
+        assert (res[0] < 128).all()
+        assert (res[1] >= 128).all()
+        assert len(res[0]) == len(res[1]) == 128
+
+    def test_save_load_roundtrip(self, rng, tmp_path):
+        n = 128
+        probs = [rng.random(n) for _ in range(2)]
+        path = str(tmp_path / "parts")
+        book, res, cache = qv.quiver_partition_feature(
+            probs, path, cache_memory_budget=64, per_feature_size=4)
+        book2, res0, cache0 = qv.load_quiver_feature_partition(0, path)
+        np.testing.assert_array_equal(book, book2)
+        np.testing.assert_array_equal(res[0], res0)
+        np.testing.assert_array_equal(cache[0], cache0)
+        # book consistent with res
+        assert (book[res[1]] == 1).all()

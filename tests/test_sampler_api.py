@@ -1,0 +1,167 @@
+"""GraphSageSampler / native CPU engine / mixed sampler tests.
+
+Mirrors the reference's test_sampler.py modes coverage plus the C++
+membership checks (test_quiver_cpu.cpp:9-78) for the native engine.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu.native import (cpu_sample_layer, cpu_sample_multihop,
+                               get_lib)
+
+
+@pytest.fixture
+def topo(rng):
+    n = 150
+    deg = rng.integers(0, 12, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]))
+    return qv.CSRTopo(indptr=indptr, indices=indices)
+
+
+def check_sample_output(topo, seeds, n_id, bs, adjs, sizes):
+    n_id = np.asarray(n_id)
+    indptr = np.asarray(topo.indptr)
+    indices = np.asarray(topo.indices)
+    nsets = [set(indices[indptr[v]:indptr[v + 1]].tolist())
+             for v in range(len(indptr) - 1)]
+    valid = n_id[n_id >= 0]
+    assert len(np.unique(valid)) == len(valid), "n_id has duplicates"
+    np.testing.assert_array_equal(valid[:len(seeds)], seeds)
+    assert len(adjs) == len(sizes)
+    # frontier of each hop: walk adjs outermost->innermost; target ids of
+    # the innermost hop are the seeds
+    frontier = n_id
+    for adj in adjs:
+        src, dst = np.asarray(adj.edge_index)
+        ok = src >= 0
+        assert (dst[ok] >= 0).all()
+        # every edge's global endpoints are a real graph edge
+        for s_local, d_local in zip(src[ok][:200], dst[ok][:200]):
+            sg, dg = frontier[s_local], frontier[d_local]
+            assert sg >= 0 and dg >= 0
+            assert sg in nsets[dg], f"{sg} not a neighbor of {dg}"
+
+
+class TestGraphSageSamplerHBM:
+    def test_end_to_end_shapes(self, topo, rng):
+        sampler = qv.GraphSageSampler(topo, sizes=[5, 3], mode="HBM")
+        seeds = rng.choice(topo.node_count, 32, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        assert bs == 32
+        check_sample_output(topo, seeds, n_id, bs, adjs, [5, 3])
+        # static caps: hop1 cap = 32*(1+5)=192, hop2 = 192*(1+3)=768
+        assert n_id.shape == (768,)
+        assert adjs[0].size == (768, 192)   # outermost hop first
+        assert adjs[1].size == (192, 32)
+
+    def test_deterministic_under_same_seed(self, topo, rng):
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        s1 = qv.GraphSageSampler(topo, [4], seed=7)
+        s2 = qv.GraphSageSampler(topo, [4], seed=7)
+        a = np.asarray(s1.sample(seeds)[0])
+        b = np.asarray(s2.sample(seeds)[0])
+        np.testing.assert_array_equal(a, b)
+
+    def test_reference_mode_names_accepted(self, topo):
+        assert qv.GraphSageSampler(topo, [3], mode="UVA").mode == "HOST"
+        assert qv.GraphSageSampler(topo, [3], mode="GPU").mode == "HBM"
+
+    def test_ipc_handle_roundtrip(self, topo, rng):
+        s = qv.GraphSageSampler(topo, [4, 2], mode="HBM")
+        s2 = qv.GraphSageSampler.lazy_from_ipc_handle(s.share_ipc())
+        seeds = rng.choice(topo.node_count, 8, replace=False)
+        n_id, bs, adjs = s2.sample(seeds)
+        check_sample_output(topo, seeds, n_id, bs, adjs, [4, 2])
+
+
+class TestNativeCPUEngine:
+    def test_native_lib_builds(self):
+        assert get_lib() is not None, "g++ build of cpu_sampler.cpp failed"
+
+    def test_membership_and_counts(self, topo, rng):
+        indptr = np.asarray(topo.indptr, np.int64)
+        indices = np.asarray(topo.indices, np.int32)
+        seeds = rng.choice(topo.node_count, 64, replace=False).astype(np.int32)
+        k = 6
+        nbrs, counts = cpu_sample_layer(indptr, indices, seeds, k, seed=1)
+        deg = np.diff(indptr)[seeds]
+        np.testing.assert_array_equal(counts, np.minimum(deg, k))
+        for i, v in enumerate(seeds):
+            row = set(indices[indptr[v]:indptr[v + 1]].tolist())
+            got = nbrs[i][:counts[i]]
+            assert set(got.tolist()) <= row
+            assert (nbrs[i][counts[i]:] == -1).all()
+
+    def test_without_replacement(self):
+        indptr = np.array([0, 100], np.int64)
+        indices = np.arange(100, dtype=np.int32)
+        nbrs, counts = cpu_sample_layer(indptr, indices,
+                                        np.zeros(50, np.int32), 10, seed=3)
+        for i in range(50):
+            assert len(set(nbrs[i].tolist())) == 10
+
+    def test_multithreaded_matches_contract(self, topo, rng):
+        indptr = np.asarray(topo.indptr, np.int64)
+        indices = np.asarray(topo.indices, np.int32)
+        seeds = np.arange(topo.node_count, dtype=np.int32)
+        nbrs, counts = cpu_sample_layer(indptr, indices, seeds, 4,
+                                        seed=5, num_threads=4)
+        deg = np.diff(indptr)
+        np.testing.assert_array_equal(counts, np.minimum(deg, 4))
+
+    def test_multihop_matches_device_shapes(self, topo, rng):
+        seeds = rng.choice(topo.node_count, 16, replace=False).astype(np.int32)
+        sizes = [4, 2]
+        n_id, rows, cols = cpu_sample_multihop(
+            np.asarray(topo.indptr), np.asarray(topo.indices), seeds, sizes)
+        assert n_id.shape == (16 * 5 * 3,)
+        assert rows[0].shape == (16 * 4,)
+        assert rows[1].shape == (80 * 2,)
+        np.testing.assert_array_equal(n_id[:16], seeds)
+
+
+class TestCPUModeSampler:
+    def test_cpu_mode_end_to_end(self, topo, rng):
+        sampler = qv.GraphSageSampler(topo, sizes=[5, 3], mode="CPU")
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_sample_output(topo, seeds, n_id, bs, adjs, [5, 3])
+
+
+class _ArrayJob(qv.SampleJob):
+    def __init__(self, train_idx, batch_size):
+        self.idx = np.asarray(train_idx)
+        self.bs = batch_size
+
+    def __getitem__(self, i):
+        return self.idx[i * self.bs:(i + 1) * self.bs]
+
+    def __len__(self):
+        return len(self.idx) // self.bs
+
+    def shuffle(self):
+        np.random.default_rng(0).shuffle(self.idx)
+
+
+class TestMixedSampler:
+    def test_yields_every_task(self, topo):
+        job = _ArrayJob(np.arange(topo.node_count)[:96], 16)
+        mixed = qv.MixedGraphSageSampler(job, [3, 2], topo, num_workers=2)
+        results = list(iter(mixed))
+        assert len(results) == 6
+        for n_id, bs, adjs in results:
+            assert bs == 16
+            assert len(adjs) == 2
+
+    def test_sample_prob_propagates(self, topo):
+        sampler = qv.GraphSageSampler(topo, sizes=[3, 2])
+        prob = np.asarray(sampler.sample_prob(
+            np.array([0, 1, 2]), topo.node_count))
+        assert prob.shape == (topo.node_count,)
+        assert (prob >= 0).all() and (prob <= 1).all()
