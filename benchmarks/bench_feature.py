@@ -1,0 +1,63 @@
+"""Feature-collection benchmark: effective gather GB/s.
+
+Mirrors the reference benchmark (benchmarks/feature/bench_feature.py,
+GB/s metric at :44-46): random-id row gather from a products-shaped
+feature array (N x 100 float32), XLA take vs the Pallas gather kernel.
+
+Usage: python benchmarks/bench_feature.py [--rows N] [--dim D]
+       [--batch B] [--iters K] [--pallas] [--bf16]
+"""
+
+import argparse
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=2_450_000)
+    p.add_argument("--dim", type=int, default=100)
+    p.add_argument("--batch", type=int, default=400_000,
+                   help="ids per gather (~a 3-hop products frontier)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--pallas", action="store_true")
+    p.add_argument("--bf16", action="store_true")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from quiver_tpu.ops.pallas.gather import gather_rows
+
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    key = jax.random.key(0)
+    feat = jax.jit(
+        lambda k: jax.random.normal(k, (args.rows, args.dim), dtype=dtype)
+    )(jax.random.fold_in(key, 1))
+
+    @jax.jit
+    def make_ids(k):
+        return jax.random.randint(k, (args.batch,), 0, args.rows,
+                                  dtype=jnp.int32)
+
+    if args.pallas:
+        run = lambda ids: gather_rows(feat, ids)
+    else:
+        run = jax.jit(lambda ids: jnp.take(feat, ids, axis=0))
+
+    out = run(make_ids(jax.random.fold_in(key, 2)))
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        out = run(make_ids(jax.random.fold_in(key, 10 + i)))
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    bytes_moved = args.iters * args.batch * args.dim * \
+        jnp.dtype(dtype).itemsize
+    label = "pallas" if args.pallas else "xla-take"
+    print(f"[{label} {dtype}] {bytes_moved / 1e9:.2f} GB in {dt:.3f}s -> "
+          f"{bytes_moved / dt / 1e9:.2f} GB/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Sampler benchmark: SEPS (sampled edges / second).
+
+Mirrors the reference benchmark (benchmarks/sample/bench_sampler.py,
+metric defined at :14-16) on a synthetic products-scale graph, comparing
+the jnp sampler and the Pallas kernel path.
+
+Usage: python benchmarks/bench_sampler.py [--nodes N] [--batch B]
+       [--sizes 15 10 5] [--batches K] [--pallas]
+"""
+
+import argparse
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=2_450_000)
+    p.add_argument("--avg-deg", type=int, default=25)
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--batches", type=int, default=20)
+    p.add_argument("--sizes", type=int, nargs="+", default=[15, 10, 5])
+    p.add_argument("--pallas", action="store_true",
+                   help="use the Pallas sampling kernel for hop 1")
+    p.add_argument("--row-cap", type=int, default=2048)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.ops.pallas.sample_kernel import (
+        pad_indices, sample_layer_pallas)
+
+    key = jax.random.key(0)
+    n = args.nodes
+
+    @jax.jit
+    def build(k):
+        ln = jax.random.normal(k, (n,)) + jnp.log(float(args.avg_deg))
+        deg = jnp.clip(jnp.exp(ln).astype(jnp.int32), 0, 10_000)
+        indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(deg)])
+        return indptr
+
+    indptr = build(jax.random.fold_in(key, 1))
+    e = int(indptr[-1])
+    indices = jax.jit(
+        lambda k: jax.random.randint(k, (e,), 0, n, dtype=jnp.int32)
+    )(jax.random.fold_in(key, 2))
+
+    if args.pallas:
+        indices_p = pad_indices(indices, args.row_cap)
+
+        @jax.jit
+        def run(seeds, k):
+            seed_scalar = jax.random.randint(k, (), 0, 2 ** 31 - 1)
+            nbrs, counts = sample_layer_pallas(
+                indptr, indices_p, seeds, args.sizes[0], seed_scalar,
+                row_cap=args.row_cap)
+            return nbrs, jnp.sum(counts)
+    else:
+        @jax.jit
+        def run(seeds, k):
+            n_id, layers = sample_multihop(indptr, indices, seeds,
+                                           args.sizes, k)
+            return n_id, sum(l.edge_count.astype(jnp.int32)
+                             for l in layers)
+
+    @jax.jit
+    def make_seeds(k):
+        return jax.random.randint(k, (args.batch,), 0, n, dtype=jnp.int32)
+
+    out, edges = run(make_seeds(jax.random.fold_in(key, 50)),
+                     jax.random.fold_in(key, 51))
+    jax.block_until_ready(out)
+
+    total = 0
+    t0 = time.perf_counter()
+    for i in range(args.batches):
+        out, edges = run(make_seeds(jax.random.fold_in(key, 100 + i)),
+                         jax.random.fold_in(key, 200 + i))
+        total += int(edges)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    label = "pallas-hop1" if args.pallas else f"jnp {args.sizes}"
+    print(f"[{label}] {total} edges in {dt:.3f}s -> "
+          f"SEPS = {total / dt / 1e6:.2f} M")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,10 @@ from .partition import (
     load_quiver_feature_partition,
     partition_feature_without_replication,
 )
+from .hetero import HeteroCSRTopo, HeteroGraphSageSampler
+from .async_sampler import AsyncNeighborSampler, AsyncCudaNeighborSampler
+from .debug import show_tensor_info
+from . import comm, profiling, checkpoint, debug
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -63,4 +67,9 @@ __all__ = [
     "quiver_partition_feature",
     "load_quiver_feature_partition",
     "partition_feature_without_replication",
+    "HeteroCSRTopo",
+    "HeteroGraphSageSampler",
+    "AsyncNeighborSampler",
+    "AsyncCudaNeighborSampler",
+    "show_tensor_info",
 ]

@@ -1,0 +1,48 @@
+"""Per-layer sampler with explicit sample/reindex steps.
+
+Capability parity with the reference's ``AsyncCudaNeighborSampler``
+(async_cuda_sampler.py:24-58) — the legacy per-layer API where the caller
+drives ``sample_layer`` and ``reindex`` itself (the reference version is
+bit-rotted against stale binding names; this one is wired to the live
+ops). On TPU "async" is the default: every call is dispatched
+asynchronously and only materializes on use.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .ops.sample import compact_layer, sample_layer
+from .utils import CSRTopo
+
+
+class AsyncNeighborSampler:
+    def __init__(self, csr_topo: CSRTopo, device=None, seed: int = 0):
+        self.csr_topo = csr_topo
+        self.device = device
+        self._key = jax.random.key(seed)
+        self._indptr = jnp.asarray(csr_topo.indptr)
+        self._indices = jnp.asarray(csr_topo.indices)
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def sample_layer(self, batch, size: int):
+        """(neighbors [bs, size] -1-filled, counts [bs])."""
+        seeds = jnp.asarray(batch, jnp.int32)
+        return sample_layer(self._indptr, self._indices, seeds, size,
+                            self.next_key())
+
+    def reindex(self, inputs, outputs, counts=None):
+        """(n_id, row, col) of the layer's bipartite graph, compacted."""
+        layer = compact_layer(jnp.asarray(inputs, jnp.int32),
+                              jnp.asarray(outputs, jnp.int32))
+        return layer.n_id, layer.row, layer.col
+
+
+# reference-compatible alias
+AsyncCudaNeighborSampler = AsyncNeighborSampler

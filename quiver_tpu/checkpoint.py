@@ -1,0 +1,56 @@
+"""Checkpoint / resume.
+
+The reference has no in-library checkpointing (survey §5: example-level
+pytorch-lightning only). Here it is first-class: orbax-backed save/
+restore of the fused TrainState plus numpy artifacts for preprocessing
+products (partitions, cache orders) — the equivalents of the
+``torch.save`` artifact files (partition.py:133-141, preprocess.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+def save_state(path: str, state: Any, step: Optional[int] = None,
+               force: bool = True):
+    """Save a pytree (e.g. ``parallel.train.TrainState``) with orbax."""
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        target = os.path.join(path, str(step)) if step is not None else path
+        ckptr.save(target, state, force=force)
+    return path
+
+
+def restore_state(path: str, example: Any, step: Optional[int] = None):
+    """Restore a pytree saved by ``save_state``; ``example`` supplies the
+    structure/shapes/dtypes."""
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    target = os.path.join(path, str(step)) if step is not None else path
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(
+            target, jax.tree.map(ocp.utils.to_shape_dtype_struct, example))
+
+
+def save_artifact(path: str, **arrays):
+    """Preprocessing artifacts (partition books, cache orders, hot
+    permutations) as a single .npz."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    np.savez(path, **{k: np.asarray(v) for k, v in arrays.items()})
+    return path
+
+
+def load_artifact(path: str) -> dict:
+    with np.load(path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}

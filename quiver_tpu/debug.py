@@ -1,0 +1,44 @@
+"""Debug / observability helpers.
+
+``show_tensor_info`` mirrors the reference's libtorch debug printer
+(tensor.cpp:25-96); ``log`` replaces the scattered ``print("LOG>>>")``
+calls (feature.py:208-210, shard_tensor.py:90-135) with a stdlib logger
+users can silence or redirect.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("quiver_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[quiver_tpu] %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+
+
+def log(msg: str, *args):
+    logger.info(msg, *args)
+
+
+def show_tensor_info(x) -> str:
+    """Shape / dtype / placement / sharding of an array, printed and
+    returned (reference: ``qv.show_tensor_info``)."""
+    if isinstance(x, jax.Array):
+        try:
+            devices = sorted(d.id for d in x.sharding.device_set)
+            placement = f"devices={devices} sharding={x.sharding}"
+        except Exception:
+            placement = "uncommitted"
+        info = (f"jax.Array shape={tuple(x.shape)} dtype={x.dtype} "
+                f"{placement} nbytes={x.nbytes}")
+    else:
+        arr = np.asarray(x)
+        info = (f"numpy shape={arr.shape} dtype={arr.dtype} "
+                f"nbytes={arr.nbytes}")
+    print(info)
+    return info

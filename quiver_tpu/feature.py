@@ -255,6 +255,18 @@ class Feature:
     def dim(self) -> int:
         return self.shape[1]
 
+    # -- pickling: drop compiled closures, rebuild on load ------------------
+    def __getstate__(self):
+        state = {k: getattr(self, k) for k in self.__dict__
+                 if k not in ("_gather_cached", "_translate")}
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._gather_cached = None
+        self._translate = None
+        self._build_gather()
+
     # -- process sharing compat ---------------------------------------------
     def share_ipc(self):
         return (self.rank, self.device_list, self.device_cache_size,

@@ -1,0 +1,168 @@
+"""Heterogeneous graph support: typed topology + relational k-hop sampler.
+
+Covers the MAG240M-class workload (BASELINE configs[3]; reference
+benchmarks/ogbn-mag240m). The reference trains on the homogeneous
+paper-cites-paper projection (train_quiver_multi_node.py:90-93) — this
+module supports that *and* true multi-relation sampling for R-GCN:
+
+- ``HeteroCSRTopo``: one CSR per relation (src_type, rel, dst_type), each
+  an ordinary ``CSRTopo`` over the dst-type id space with src-type ids as
+  indices (CSR rows = dst nodes, matching the sampling direction:
+  frontier nodes pull their in-neighbors).
+- ``HeteroGraphSageSampler``: per hop, every relation samples ``k`` of
+  the current dst-type frontier's neighbors; per node type, the frontier
+  union is compacted with the same first-occurrence static-shape
+  compaction as the homogeneous path.
+
+All shapes static; same -1 masking contract as the homogeneous sampler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops.sample import compact_union, sample_layer
+from .pyg.sage_sampler import Adj
+from .utils import CSRTopo
+
+EdgeType = Tuple[str, str, str]          # (src_type, relation, dst_type)
+
+
+class HeteroCSRTopo:
+    """Typed topology: ``rels[(src, rel, dst)] = CSRTopo`` whose row v
+    (a dst-type node) lists its src-type in-neighbors."""
+
+    def __init__(self, rels: Dict[EdgeType, CSRTopo],
+                 node_counts: Dict[str, int]):
+        self.rels = dict(rels)
+        self.node_counts = dict(node_counts)
+        for (src, rel, dst), topo in self.rels.items():
+            if topo.node_count < self.node_counts.get(dst, 0):
+                raise ValueError(
+                    f"relation {(src, rel, dst)} CSR has {topo.node_count} "
+                    f"rows < dst node_count {self.node_counts[dst]}")
+
+    @property
+    def edge_types(self) -> List[EdgeType]:
+        return list(self.rels.keys())
+
+    @property
+    def node_types(self) -> List[str]:
+        return list(self.node_counts.keys())
+
+
+class HeteroLayer(NamedTuple):
+    """One sampled hop of a hetero graph.
+
+    adjs:     {edge_type: Adj} — local bipartite COO per relation; source
+              local ids index the *next* frontier of the src type, target
+              local ids index the current frontier of the dst type.
+    frontier: {node_type: n_id array} AFTER this hop (input to next hop /
+              feature gather), -1-filled static caps.
+    counts:   {node_type: valid count in frontier}
+    """
+
+    adjs: Dict[EdgeType, Adj]
+    frontier: Dict[str, jax.Array]
+    counts: Dict[str, jax.Array]
+
+
+class HeteroGraphSageSampler:
+    """Relational neighbor sampler.
+
+    ``sizes`` is a list of per-hop fanouts; each entry is either an int
+    (same fanout for every relation) or a ``{edge_type: k}`` dict.
+    ``sample(seeds)`` seeds are nodes of ``seed_type``.
+    """
+
+    def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
+                 seed_type: str, seed: int = 0):
+        self.topo = topo
+        self.seed_type = seed_type
+        self.sizes = [s if isinstance(s, dict)
+                      else {et: s for et in topo.edge_types}
+                      for s in sizes]
+        self._key = jax.random.key(seed)
+        self._fn_cache = {}
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _build(self, batch_size: int):
+        sizes = self.sizes
+        rels = {et: (jnp.asarray(t.indptr), jnp.asarray(t.indices))
+                for et, t in self.topo.rels.items()}
+        seed_type = self.seed_type
+        node_types = self.topo.node_types
+
+        def run(seeds, key):
+            frontier = {t: None for t in node_types}
+            frontier[seed_type] = seeds.astype(jnp.int32)
+            hops = []
+            step = 0
+            for hop, fanouts in enumerate(sizes):
+                per_rel_samples: Dict[EdgeType, tuple] = {}
+                # 1. sample every relation whose dst type has a frontier
+                for et, k in fanouts.items():
+                    src_t, _, dst_t = et
+                    cur = frontier[dst_t]
+                    if cur is None or k <= 0:
+                        continue
+                    sub = jax.random.fold_in(key, step)
+                    step += 1
+                    indptr, indices = rels[et]
+                    nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
+                    per_rel_samples[et] = (cur, nbrs)
+                # 2. per src type: compact (old frontier ++ all sampled)
+                new_frontier = dict(frontier)
+                new_counts = {}
+                adjs = {}
+                by_src: Dict[str, list] = {}
+                for et, (cur, nbrs) in per_rel_samples.items():
+                    by_src.setdefault(et[0], []).append((et, cur, nbrs))
+                for src_t, group in by_src.items():
+                    prev = frontier[src_t]
+                    prev = prev if prev is not None else \
+                        jnp.full((0,), -1, jnp.int32)
+                    all_nbrs = jnp.concatenate(
+                        [nbrs.reshape(-1) for _, _, nbrs in group])
+                    n_id, n_count, extra_local = compact_union(prev, all_nbrs)
+                    # n_id holds prev ++ unique new, first-occurrence order
+                    new_frontier[src_t] = n_id
+                    new_counts[src_t] = n_count
+                    # 3. per relation: local COO against the merged frontier
+                    offset = 0
+                    for et, cur, nbrs in group:
+                        s, kk = nbrs.shape
+                        flat = extra_local[offset:offset + s * kk]
+                        offset += s * kk
+                        row = jnp.where(
+                            flat >= 0,
+                            jnp.repeat(jnp.arange(s, dtype=jnp.int32), kk),
+                            -1)
+                        edge_index = jnp.stack([flat, row])
+                        adjs[et] = Adj(
+                            edge_index=edge_index, e_id=flat >= 0,
+                            size=(int(n_id.shape[0]), s))
+                hops.append((adjs, dict(new_frontier), new_counts))
+                frontier = new_frontier
+            return frontier, hops
+
+        return jax.jit(run)
+
+    def sample(self, seeds):
+        seeds = jnp.asarray(seeds, jnp.int32)
+        bs = int(seeds.shape[0])
+        fn = self._fn_cache.get(bs)
+        if fn is None:
+            fn = self._build(bs)
+            self._fn_cache[bs] = fn
+        frontier, hops = fn(seeds, self.next_key())
+        layers = [HeteroLayer(adjs=a, frontier=f, counts=c)
+                  for a, f, c in hops]
+        return frontier, bs, layers[::-1]

@@ -1,4 +1,7 @@
 from .sage import SAGEConv, GraphSAGE
 from .gat import GATConv, GAT
+from .rgcn import RGCNConv, RGCN
+from .mag import MAG240MGNN
 
-__all__ = ["SAGEConv", "GraphSAGE", "GATConv", "GAT"]
+__all__ = ["SAGEConv", "GraphSAGE", "GATConv", "GAT",
+           "RGCNConv", "RGCN", "MAG240MGNN"]

@@ -1,0 +1,47 @@
+"""MAG240M-style deep GNN: GAT or GraphSAGE trunk + skip connections +
+norm + MLP head.
+
+Capability parity with the reference benchmark model
+(benchmarks/ogbn-mag240m/train_quiver_multi_node.py:187-245): per-hop
+conv, skip Linear for the GAT variant, norm + ReLU/ELU, dropout, then a
+2-layer MLP classifier. LayerNorm stands in for BatchNorm1d (stateless
+under jit; same normalization role)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+from .gat import GATConv
+from .sage import SAGEConv
+
+
+class MAG240MGNN(nn.Module):
+    model: str                      # 'graphsage' | 'gat'
+    hidden_dim: int
+    out_dim: int
+    num_layers: int
+    heads: int = 4
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, adjs, *, train: bool = False):
+        assert self.model in ("graphsage", "gat")
+        for i, adj in enumerate(adjs):
+            x_target = x[:adj.size[1]]
+            if self.model == "gat":
+                conv = GATConv(self.hidden_dim // self.heads,
+                               heads=self.heads, concat=True,
+                               name=f"conv{i}")
+                h = conv(x, x_target, adj.edge_index)
+                h = h + nn.Dense(self.hidden_dim, name=f"skip{i}")(x_target)
+                h = nn.elu(nn.LayerNorm(name=f"norm{i}")(h))
+            else:
+                conv = SAGEConv(self.hidden_dim, name=f"conv{i}")
+                h = conv(x, x_target, adj.edge_index)
+                h = nn.relu(nn.LayerNorm(name=f"norm{i}")(h))
+            x = nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = nn.Dense(self.hidden_dim, name="mlp0")(x)
+        h = nn.relu(nn.LayerNorm(name="mlp_norm")(h))
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return nn.Dense(self.out_dim, name="mlp1")(h)

@@ -1,0 +1,64 @@
+"""Relational GCN over heterogeneous sampled layers.
+
+The R-GCN capability for the MAG240M-class config (BASELINE configs[3]):
+per-relation weight matrices, mean aggregation per relation, summed into
+the destination type, plus a per-type self transform.
+
+Consumes ``HeteroLayer`` hops from ``quiver_tpu.hetero`` (outermost hop
+first). Per-type frontiers are prefix-ordered (pre-hop frontier first),
+so the PyG ``x_target = x[:cap]`` pattern works per node type.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import flax.linen as nn
+import jax
+
+from .sage import masked_mean_aggregate
+
+
+class RGCNConv(nn.Module):
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x: Dict[str, jax.Array], adjs: Dict[tuple, jax.Array]):
+        agg: Dict[str, jax.Array] = {}
+        dst_cap: Dict[str, int] = {}
+        for (src_t, rel, dst_t), adj in adjs.items():
+            mean = masked_mean_aggregate(
+                x[src_t], adj.edge_index, adj.size[1])
+            h = nn.Dense(self.out_dim, use_bias=False,
+                         name=f"rel__{src_t}__{rel}__{dst_t}")(mean)
+            agg[dst_t] = agg.get(dst_t, 0) + h
+            dst_cap[dst_t] = adj.size[1]
+        out = {}
+        for dst_t, msg in agg.items():
+            x_dst = x[dst_t][:dst_cap[dst_t]]
+            out[dst_t] = nn.Dense(self.out_dim,
+                                  name=f"self__{dst_t}")(x_dst) + msg
+        return out
+
+
+class RGCN(nn.Module):
+    """Multi-hop R-GCN; returns logits for the seed-type targets."""
+
+    hidden_dim: int
+    out_dim: int
+    num_layers: int
+    seed_type: str
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: Dict[str, jax.Array], hetero_layers,
+                 *, train: bool = False):
+        for i, layer in enumerate(hetero_layers):
+            last = i == self.num_layers - 1
+            dim = self.out_dim if last else self.hidden_dim
+            x = RGCNConv(dim, name=f"conv{i}")(x, layer.adjs)
+            if not last:
+                x = {t: nn.Dropout(self.dropout,
+                                   deterministic=not train)(nn.relu(v))
+                     for t, v in x.items()}
+        return x[self.seed_type]

@@ -1,0 +1,17 @@
+"""Cross-process sharing compat layer.
+
+The reference needs ForkingPickler reducers to push CUDA-IPC handles into
+``mp.spawn`` workers (multiprocessing/reductions.py:5-33) because torch
+DDP runs one python process per GPU. On TPU one process per host drives
+all local chips, so there is nothing to share — but the API is kept so
+reference code importing ``quiver.multiprocessing`` keeps working, and so
+``Feature``/samplers can still be pickled into *host-side* worker
+processes (e.g. CPU sampling workers): device arrays are reduced to host
+numpy and re-placed on unpickle.
+"""
+
+from .reductions import init_reductions
+
+init_reductions()
+
+__all__ = ["init_reductions"]

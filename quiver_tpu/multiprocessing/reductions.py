@@ -1,0 +1,36 @@
+"""Pickle reducers for framework objects crossing host process
+boundaries (capability analogue of reference reductions.py:5-33)."""
+
+from __future__ import annotations
+
+import copyreg
+
+import jax
+import numpy as np
+
+
+def _reduce_jax_array(arr):
+    return (_rebuild_jax_array, (np.asarray(jax.device_get(arr)),))
+
+
+def _rebuild_jax_array(np_arr):
+    import jax.numpy as jnp
+    return jnp.asarray(np_arr)
+
+
+def init_reductions():
+    """Register reducers so jax.Array leaves inside Feature / sampler
+    objects survive pickling into worker processes.
+
+    Pickler dispatch keys on the *concrete* class (ArrayImpl), not the
+    abstract ``jax.Array``, so register the implementation type directly.
+    """
+    try:
+        from jax._src.array import ArrayImpl
+        copyreg.pickle(ArrayImpl, _reduce_jax_array)
+    except ImportError:
+        # private path moved: materialize a tiny CPU array to get the
+        # concrete class (cpu backend only; cheap)
+        concrete = type(jax.device_put(
+            np.zeros(1), jax.local_devices(backend="cpu")[0]))
+        copyreg.pickle(concrete, _reduce_jax_array)

@@ -6,6 +6,10 @@ from .sample import (
     LayerSample,
 )
 from .sample_multihop import sample_multihop
+from .weighted import (
+    sample_layer_weighted,
+    csr_weights_from_eid,
+)
 
 __all__ = [
     "sample_layer",
@@ -13,5 +17,7 @@ __all__ = [
     "sample_prob_step",
     "sample_prob",
     "sample_multihop",
+    "sample_layer_weighted",
+    "csr_weights_from_eid",
     "LayerSample",
 ]

@@ -1,0 +1,173 @@
+"""Pallas TPU neighbor-sampling kernel.
+
+The hot-path equivalent of the reference's warp-per-row reservoir kernel
+``CSRRowWiseSampleKernel`` (cuda_random.cu.hpp:7-69). Design, TPU-first:
+
+- grid over blocks of 128 seeds; each block DMAs its seeds' neighbor rows
+  (up to ``row_cap`` entries each) from the CSR ``indices`` array in HBM
+  into a VMEM staging buffer (the TPU analogue of the reference's UVA
+  streaming reads).
+- selection is a *vectorized* partial Fisher-Yates over the whole block
+  ([BLOCK, k] lanes in the VPU) using the on-core PRNG — same
+  distribution as the jnp oracle, no atomics, no serial per-row loops.
+- the chosen positions are materialized with an iota-compare reduction
+  over the staged rows (VPU), avoiding unsupported dynamic VMEM gathers.
+
+Contract matches ``ops.sample.sample_layer``: (nbrs [bs,k] -1-filled,
+counts = min(deg, k)). Rows with degree > ``row_cap`` sample uniformly
+from their first ``row_cap`` neighbors (documented truncation; CSR
+neighbor order is arbitrary, and row_cap=2048 covers the >99.9th degree
+percentile of the target graphs).
+
+``indices`` must be padded with ``row_cap`` trailing entries
+(``pad_indices``) so fixed-size row DMAs never read out of bounds.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 128
+
+
+def pad_indices(indices: jax.Array, row_cap: int) -> jax.Array:
+    """Append row_cap sentinel entries so row DMAs can overread safely."""
+    return jnp.concatenate(
+        [indices, jnp.zeros((row_cap,), indices.dtype)])
+
+
+def _fy_positions(degs: jax.Array, k: int, row_cap: int):
+    """Vectorized partial Fisher-Yates inside the kernel: positions
+    [BLOCK, k] without replacement in [0, min(deg, row_cap))."""
+    bs = degs.shape[0]
+    pool = jnp.minimum(degs, row_cap)                     # candidate pool
+    pos_log = jnp.full((bs, k), -1, jnp.int32)
+    val_log = jnp.zeros((bs, k), jnp.int32)
+    outs = []
+    steps = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)  # [1, k]
+
+    def lookup(pos_log, val_log, x):
+        match = pos_log == x[:, None]
+        last = jnp.max(jnp.where(match, steps, -1), axis=1)
+        # take_along_axis-free: select the logged value at step `last`
+        onehot = (steps == last[:, None]) & (last[:, None] >= 0)
+        logged = jnp.sum(jnp.where(onehot, val_log, 0), axis=1)
+        return jnp.where(last >= 0, logged, x)
+
+    for i in range(k):
+        rbits = pltpu.bitcast(
+            pltpu.prng_random_bits((1, bs)), jnp.uint32)[0]
+        span = jnp.maximum(pool - i, 1).astype(jnp.uint32)
+        j = (i + (rbits % span)).astype(jnp.int32)
+        a_j = lookup(pos_log, val_log, j)
+        a_i = lookup(pos_log, val_log, jnp.full((bs,), i, jnp.int32))
+        outs.append(a_j)
+        onehot_i = steps == i
+        pos_log = jnp.where(onehot_i, j[:, None], pos_log)
+        val_log = jnp.where(onehot_i, a_i[:, None], val_log)
+    return jnp.stack(outs, axis=1)                        # [bs, k]
+
+
+def _make_kernel(k: int, row_cap: int):
+    def kernel(starts_smem, degs_ref, seed_ref, indices_hbm,
+               out_ref, cnt_ref, rows_vmem, sems):
+        blk = pl.program_id(0)
+        pltpu.prng_seed(seed_ref[0] + blk)
+
+        # stage BLOCK neighbor rows HBM -> VMEM (row_cap each)
+        def start_dma(i, _):
+            s = starts_smem[i]
+            pltpu.make_async_copy(
+                indices_hbm.at[pl.ds(s, row_cap)],
+                rows_vmem.at[i], sems.at[i]).start()
+            return 0
+
+        jax.lax.fori_loop(0, BLOCK, start_dma, 0)
+
+        degs = degs_ref[0]                                # [BLOCK]
+        pos = _fy_positions(degs, k, row_cap)             # [BLOCK, k]
+
+        def wait_dma(i, _):
+            pltpu.make_async_copy(
+                indices_hbm.at[pl.ds(starts_smem[i], row_cap)],
+                rows_vmem.at[i], sems.at[i]).wait()
+            return 0
+
+        jax.lax.fori_loop(0, BLOCK, wait_dma, 0)
+
+        rows = rows_vmem[:, :]                            # [BLOCK, row_cap]
+        r_iota = jax.lax.broadcasted_iota(
+            jnp.int32, (BLOCK, row_cap), 1)
+        counts = jnp.minimum(degs, k).astype(jnp.int32)
+        for i in range(k):
+            sel = jnp.sum(
+                jnp.where(r_iota == pos[:, i][:, None], rows, 0), axis=1)
+            valid_i = i < counts
+            out_ref[:, i] = jnp.where(valid_i, sel.astype(jnp.int32), -1)
+        cnt_ref[0] = counts
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "row_cap", "interpret"))
+def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
+                        seeds: jax.Array, k: int, seed,
+                        row_cap: int = 2048,
+                        interpret: bool = False):
+    """Drop-in for ``ops.sample.sample_layer`` backed by the TPU kernel.
+
+    ``indices_padded`` comes from ``pad_indices``; ``seed`` is a scalar
+    int32 (derive from a jax PRNG key via ``jax.random.randint``).
+    """
+    n = indptr.shape[0] - 1
+    bs = seeds.shape[0]
+    pad = (-bs) % BLOCK
+    if pad:
+        seeds = jnp.concatenate([seeds, jnp.full((pad,), -1, seeds.dtype)])
+    padded_bs = seeds.shape[0]
+
+    valid = seeds >= 0
+    safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
+    starts = jnp.where(valid, indptr[safe], 0).astype(jnp.int32)
+    degs = jnp.where(valid, (indptr[safe + 1] - indptr[safe]), 0) \
+        .astype(jnp.int32)
+
+    grid = padded_bs // BLOCK
+    out, cnt = pl.pallas_call(
+        _make_kernel(k, row_cap),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BLOCK,), lambda b: (b,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((BLOCK, k), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_bs, k), jnp.int32),
+            jax.ShapeDtypeStruct((grid, BLOCK), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, row_cap), indices_padded.dtype),
+            pltpu.SemaphoreType.DMA((BLOCK,)),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts,
+      degs.reshape(grid, BLOCK),
+      jnp.asarray(seed, jnp.int32).reshape(1),
+      indices_padded)
+    return out[:bs], cnt.reshape(-1)[:bs]

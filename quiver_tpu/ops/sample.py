@@ -120,16 +120,16 @@ def sample_layer(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     return nbrs, counts
 
 
-def compact_layer(seeds: jax.Array, nbrs: jax.Array) -> LayerSample:
-    """Deduplicate ``concat(seeds, nbrs)`` preserving first-occurrence order
-    and emit the layer's bipartite COO in local (compacted) ids.
+def compact_ids(ids: jax.Array):
+    """Deduplicate a -1-padded id vector preserving first-occurrence order.
 
-    seeds: [s] int32, -1 fill allowed. nbrs: [s, k] int32, -1 fill.
-    Output capacity is the static ``s + s*k``.
+    Returns (n_id [cap] -1-filled, n_count, local_ids [cap]) where
+    ``local_ids[i]`` is the position of ``ids[i]`` in ``n_id`` (garbage
+    where ``ids[i] < 0``). This is the sort-based replacement for the
+    reference's device ordered hashtable (reindex.cu.hpp:20-183).
     """
-    s, k = nbrs.shape
-    cap = s + s * k
-    ids = jnp.concatenate([seeds, nbrs.reshape(-1)]).astype(jnp.int32)
+    cap = ids.shape[0]
+    ids = ids.astype(jnp.int32)
     valid = ids >= 0
     sent = jnp.iinfo(jnp.int32).max
     keyed = jnp.where(valid, ids, sent)
@@ -159,8 +159,31 @@ def compact_layer(seeds: jax.Array, nbrs: jax.Array) -> LayerSample:
     seg_of_elem = jnp.zeros((cap,), jnp.int32).at[order].set(
         seg.astype(jnp.int32))
     local_ids = local_of_seg[seg_of_elem]                    # [cap]
+    return n_id, n_count, local_ids
 
-    nbr_valid = valid[s:]
+
+def compact_union(prefix_ids: jax.Array, extra_ids: jax.Array):
+    """Union ``prefix_ids ++ extra_ids`` (both -1-padded, any lengths),
+    prefix first. Returns (n_id, n_count, local_ids_of_extra)."""
+    p = prefix_ids.shape[0]
+    n_id, n_count, local = compact_ids(
+        jnp.concatenate([prefix_ids.astype(jnp.int32),
+                         extra_ids.astype(jnp.int32)]))
+    extra_local = jnp.where(extra_ids >= 0, local[p:], -1)
+    return n_id, n_count, extra_local
+
+
+def compact_layer(seeds: jax.Array, nbrs: jax.Array) -> LayerSample:
+    """Deduplicate ``concat(seeds, nbrs)`` preserving first-occurrence order
+    and emit the layer's bipartite COO in local (compacted) ids.
+
+    seeds: [s] int32, -1 fill allowed. nbrs: [s, k] int32, -1 fill.
+    Output capacity is the static ``s + s*k``.
+    """
+    s, k = nbrs.shape
+    n_id, n_count, local_ids = compact_ids(
+        jnp.concatenate([seeds, nbrs.reshape(-1)]))
+    nbr_valid = nbrs.reshape(-1) >= 0
     col = jnp.where(nbr_valid, local_ids[s:], -1)
     row = jnp.where(
         nbr_valid,

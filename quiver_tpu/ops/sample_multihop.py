@@ -10,19 +10,28 @@ import jax
 import jax.numpy as jnp
 
 from .sample import LayerSample, compact_layer, sample_layer
+from .weighted import sample_layer_weighted
 
 
 def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
-                    sizes: Sequence[int], key: jax.Array
+                    sizes: Sequence[int], key: jax.Array,
+                    edge_weight: jax.Array | None = None,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
-    sampling order (innermost target hop first)."""
+    sampling order (innermost target hop first).
+
+    ``edge_weight`` (CSR-slot-aligned) switches every hop to weighted
+    sampling."""
     cur = seeds.astype(jnp.int32)
     layers: List[LayerSample] = []
     for i, k in enumerate(sizes):
         sub = jax.random.fold_in(key, i)
-        nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
+        if edge_weight is None:
+            nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
+        else:
+            nbrs, _ = sample_layer_weighted(indptr, indices, edge_weight,
+                                            cur, k, sub)
         layer = compact_layer(cur, nbrs)
         layers.append(layer)
         cur = layer.n_id

@@ -1,0 +1,83 @@
+"""Profiling / tracing hooks.
+
+Replaces the reference's compile-time ``TRACE_SCOPE`` macros + RAII timer
+(trace.hpp:1-14, timer.hpp:7-29, enabled via QUIVER_ENABLE_TRACE +
+stdtracer FetchContent) with jax's built-in profiler: named scopes land
+in the XLA trace viewer, ``trace`` dumps a TensorBoard-compatible
+profile, and ``ScopeTimer`` gives the wall-clock numbers the reference
+printed ad hoc (sage_sampler.py:324-348).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+
+# named scope: annotates ops for the profiler (the TRACE_SCOPE equivalent)
+scope = jax.named_scope
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device profile: ``with qt.profiling.trace('/tmp/prof'):``
+    then inspect with TensorBoard/XProf."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Decorator form of ``scope`` for hot functions."""
+    def wrap(fn):
+        def inner(*args, **kwargs):
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+        inner.__name__ = getattr(fn, "__name__", name)
+        return inner
+    return wrap
+
+
+class ScopeTimer:
+    """Accumulating wall-clock timer with block-until-ready semantics.
+
+    >>> t = ScopeTimer()
+    >>> with t.measure("sample"):
+    ...     out = sampler.sample(seeds)
+    >>> t.summary()
+    """
+
+    def __init__(self):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def measure(self, name: str, block_on=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block_on is not None:
+                jax.block_until_ready(block_on)
+            dt = time.perf_counter() - t0
+            self.totals[name] += dt
+            self.counts[name] += 1
+
+    def mean(self, name: str) -> float:
+        c = self.counts.get(name, 0)
+        return self.totals[name] / c if c else 0.0
+
+    def summary(self) -> str:
+        lines = [f"{k}: {self.totals[k]:.4f}s total, "
+                 f"{self.mean(k) * 1e3:.2f} ms/call x{self.counts[k]}"
+                 for k in sorted(self.totals)]
+        return "\n".join(lines)
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()

@@ -32,22 +32,39 @@ from ..utils import CSRTopo
 T_co = TypeVar("T_co", covariant=True)
 
 
-class Adj(NamedTuple):
+@jax.tree_util.register_pytree_node_class
+class Adj:
     """One message-passing hop, PyG orientation (source -> target).
 
     edge_index: [2, cap_edges] int32, -1 fill; row 0 = source (neighbor)
                 local id, row 1 = target (seed) local id.
     e_id:       [cap_edges] placeholder (empty semantics, like the
                 reference's ``e_id=[]``); holds the validity mask.
-    size:       (cap_source_nodes, cap_target_nodes) static capacities.
+    size:       (cap_source_nodes, cap_target_nodes) static capacities —
+                pytree aux data, so Adjs cross jit boundaries safely.
+
+    Supports PyG-style destructuring: ``edge_index, e_id, size = adj``.
     """
 
-    edge_index: jax.Array
-    e_id: jax.Array
-    size: tuple
+    __slots__ = ("edge_index", "e_id", "size")
+
+    def __init__(self, edge_index, e_id, size):
+        self.edge_index = edge_index
+        self.e_id = e_id
+        self.size = tuple(size)
+
+    def __iter__(self):
+        return iter((self.edge_index, self.e_id, self.size))
 
     def to(self, *args, **kwargs):  # API compat; placement is explicit in jax
         return self
+
+    def tree_flatten(self):
+        return (self.edge_index, self.e_id), self.size
+
+    @classmethod
+    def tree_unflatten(cls, size, leaves):
+        return cls(leaves[0], leaves[1], size)
 
 
 class _LayerShape(NamedTuple):
@@ -71,7 +88,8 @@ class GraphSageSampler:
     ``NeighborSampler`` (reference: sage_sampler.py:118-147)."""
 
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int],
-                 device=None, mode: str = "HBM", seed: int = 0):
+                 device=None, mode: str = "HBM", seed: int = 0,
+                 edge_weight=None):
         if mode not in ("HBM", "HOST", "CPU", "UVA", "GPU"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         # accept reference mode names: UVA -> HOST tier, GPU -> HBM
@@ -80,8 +98,14 @@ class GraphSageSampler:
         self.sizes = list(sizes)
         self.csr_topo = csr_topo
         self.device = device
+        # CSR-slot-aligned edge weights => weighted (attention) sampling;
+        # use ops.weighted.csr_weights_from_eid for COO-ordered weights
+        self.edge_weight = edge_weight
+        if edge_weight is not None and mode == "CPU":
+            raise ValueError("weighted sampling runs on the device path")
         self._key = jax.random.key(seed)
         self._placed = None
+        self._weight_placed = None
         self._fns = {}
 
     # -- placement ----------------------------------------------------------
@@ -115,17 +139,12 @@ class GraphSageSampler:
     # -- core ---------------------------------------------------------------
     def _build_fn(self, batch_size: int):
         sizes = self.sizes
+        weighted = self.edge_weight is not None
 
-        def run(indptr, indices, seeds, key):
-            cur = seeds
-            layers = []
-            for i, k in enumerate(sizes):
-                sub = jax.random.fold_in(key, i)
-                nbrs, _counts = sample_layer(indptr, indices, cur, k, sub)
-                layer = compact_layer(cur, nbrs)
-                layers.append(layer)
-                cur = layer.n_id
-            return cur, layers
+        def run(indptr, indices, seeds, key, weights=None):
+            from ..ops.sample_multihop import sample_multihop
+            return sample_multihop(indptr, indices, seeds, sizes, key,
+                                   edge_weight=weights if weighted else None)
 
         return jax.jit(run)
 
@@ -150,8 +169,10 @@ class GraphSageSampler:
         if self.mode == "CPU":
             return self._sample_cpu(seeds, bs)
         fn = self._fn_for(bs)
+        if self.edge_weight is not None and self._weight_placed is None:
+            self._weight_placed = jnp.asarray(self.edge_weight)
         n_id, layers = fn(jnp.asarray(indptr), jnp.asarray(indices),
-                          seeds, self.next_key())
+                          seeds, self.next_key(), self._weight_placed)
         shapes = layer_shapes(bs, self.sizes)
         adjs = []
         for layer, shape in zip(layers, shapes):
@@ -201,12 +222,14 @@ class GraphSageSampler:
 
     # -- process sharing (API compat; jax is single-process-per-host) -------
     def share_ipc(self):
-        return (self.csr_topo, self.device, self.mode, self.sizes)
+        return (self.csr_topo, self.device, self.mode, self.sizes,
+                self.edge_weight)
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
-        csr_topo, device, mode, sizes = ipc_handle
-        return cls(csr_topo, sizes, device=device, mode=mode)
+        csr_topo, device, mode, sizes, edge_weight = ipc_handle
+        return cls(csr_topo, sizes, device=device, mode=mode,
+                   edge_weight=edge_weight)
 
 
 class SampleJob(Generic[T_co]):

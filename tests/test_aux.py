@@ -1,0 +1,84 @@
+"""Auxiliary subsystem tests: checkpointing, profiling hooks, debug
+utils, pickle reductions, async per-layer sampler."""
+
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu import checkpoint, profiling
+from quiver_tpu.parallel.train import TrainState
+
+
+class TestCheckpoint:
+    def test_state_roundtrip(self, tmp_path):
+        params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones((3,))}
+        tx = optax.adam(1e-3)
+        state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+        path = str(tmp_path / "ckpt")
+        checkpoint.save_state(path, state)
+        restored = checkpoint.restore_state(path, state)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                    np.asarray(b)),
+            state.params, restored.params)
+
+    def test_artifact_roundtrip(self, tmp_path):
+        path = str(tmp_path / "art.npz")
+        checkpoint.save_artifact(path, book=np.arange(10),
+                                 order=np.arange(5)[::-1])
+        art = checkpoint.load_artifact(path)
+        np.testing.assert_array_equal(art["book"], np.arange(10))
+        np.testing.assert_array_equal(art["order"], np.arange(5)[::-1])
+
+
+class TestProfiling:
+    def test_scope_timer(self):
+        t = profiling.ScopeTimer()
+        with t.measure("op"):
+            _ = jnp.arange(10).sum()
+        assert t.counts["op"] == 1
+        assert "op" in t.summary()
+
+    def test_named_scope_wraps(self):
+        @profiling.annotate("my_op")
+        def f(x):
+            return x * 2
+        assert int(f(jnp.asarray(3))) == 6
+
+
+class TestDebug:
+    def test_show_tensor_info(self, capsys):
+        info = qv.show_tensor_info(jnp.zeros((4, 2)))
+        assert "shape=(4, 2)" in info
+        info2 = qv.show_tensor_info(np.zeros(3))
+        assert "numpy" in info2
+
+
+class TestReductions:
+    def test_feature_pickles_across_device_arrays(self, rng):
+        feat = rng.standard_normal((20, 4)).astype(np.float32)
+        f = qv.Feature(device_cache_size=feat.nbytes)
+        f.from_cpu_tensor(feat)
+        blob = pickle.dumps(f)
+        f2 = pickle.loads(blob)
+        ids = np.array([0, 7, 19])
+        np.testing.assert_allclose(np.asarray(f2[jnp.asarray(ids)]),
+                                   feat[ids], rtol=1e-6)
+
+
+class TestAsyncSampler:
+    def test_per_layer_api(self, small_graph, rng):
+        indptr, indices = small_graph
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        s = qv.AsyncNeighborSampler(topo)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        nbrs, counts = s.sample_layer(seeds, 4)
+        assert nbrs.shape == (16, 4)
+        n_id, row, col = s.reindex(jnp.asarray(seeds, jnp.int32), nbrs)
+        np.testing.assert_array_equal(np.asarray(n_id)[:16], seeds)
+        assert qv.AsyncCudaNeighborSampler is qv.AsyncNeighborSampler

@@ -1,0 +1,97 @@
+"""Weighted (attention) sampling tests: distribution matches edge
+weights, masking contract matches the uniform sampler."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu.ops import (
+    csr_weights_from_eid, sample_layer_weighted)
+
+KEY = jax.random.key(0)
+
+
+class TestWeightedLayer:
+    def test_distribution_follows_weights(self):
+        # node 0 has 4 neighbors with weights 1,2,3,4 -> p = w/10
+        indptr = jnp.asarray(np.array([0, 4]))
+        indices = jnp.asarray(np.arange(4))
+        w = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        seeds = jnp.zeros((2048,), jnp.int32)
+        hits = np.zeros(4)
+        for t in range(10):
+            nbrs, counts = sample_layer_weighted(
+                indptr, indices, jnp.asarray(w), seeds, 2,
+                jax.random.fold_in(KEY, t))
+            ids, cnt = np.unique(np.asarray(nbrs), return_counts=True)
+            hits[ids] += cnt
+        freq = hits / hits.sum()
+        np.testing.assert_allclose(freq, w / w.sum(), atol=0.01)
+
+    def test_membership_and_counts(self, small_graph, rng):
+        indptr, indices = small_graph
+        w = rng.random(len(indices)).astype(np.float32) + 0.1
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        k = 5
+        nbrs, counts = sample_layer_weighted(
+            jnp.asarray(indptr), jnp.asarray(indices), jnp.asarray(w),
+            jnp.asarray(seeds), k, KEY)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        deg = np.diff(indptr)
+        np.testing.assert_array_equal(counts, np.minimum(deg, k))
+        for i, v in enumerate(seeds):
+            row = set(indices[indptr[v]:indptr[v + 1]].tolist())
+            got = nbrs[i][nbrs[i] >= 0]
+            assert set(got.tolist()) <= row
+
+    def test_zero_weight_edges_never_sampled(self):
+        indptr = jnp.asarray(np.array([0, 3]))
+        indices = jnp.asarray(np.array([10, 20, 30]))
+        w = jnp.asarray(np.array([0.0, 1.0, 0.0], np.float32))
+        seeds = jnp.zeros((256,), jnp.int32)
+        nbrs, _ = sample_layer_weighted(indptr, indices, w, seeds, 2, KEY)
+        got = np.unique(np.asarray(nbrs))
+        assert set(got.tolist()) <= {20}
+
+    def test_zero_mass_row_masked(self):
+        indptr = jnp.asarray(np.array([0, 2]))
+        indices = jnp.asarray(np.array([1, 2]))
+        nbrs, counts = sample_layer_weighted(
+            indptr, indices, jnp.zeros((2,), jnp.float32),
+            jnp.zeros((4,), jnp.int32), 3, KEY)
+        assert int(np.asarray(counts).sum()) == 0
+        assert (np.asarray(nbrs) == -1).all()
+
+    def test_eid_alignment(self, rng):
+        # COO weights reordered into CSR slot order through CSRTopo.eid
+        n, e = 30, 200
+        edge_index = np.stack([rng.integers(0, n, e),
+                               rng.integers(0, n, e)])
+        topo = qv.CSRTopo(edge_index=edge_index, node_count=n)
+        coo_w = rng.random(e).astype(np.float32)
+        csr_w = np.asarray(csr_weights_from_eid(topo.eid, coo_w))
+        # oracle: sort by row, stable
+        order = np.argsort(edge_index[0], kind="stable")
+        np.testing.assert_allclose(csr_w, coo_w[order])
+
+
+class TestWeightedSamplerAPI:
+    def test_end_to_end(self, small_graph, rng):
+        indptr, indices = small_graph
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        w = rng.random(len(indices)).astype(np.float32)
+        s = qv.GraphSageSampler(topo, [4, 2], edge_weight=w)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = s.sample(seeds)
+        assert bs == 16
+        assert len(adjs) == 2
+        np.testing.assert_array_equal(np.asarray(n_id)[:16], seeds)
+
+    def test_cpu_mode_rejected(self, small_graph):
+        indptr, indices = small_graph
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        with pytest.raises(ValueError):
+            qv.GraphSageSampler(topo, [4], mode="CPU",
+                                edge_weight=np.ones(len(indices)))
