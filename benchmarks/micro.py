@@ -1,0 +1,104 @@
+"""Microbenchmarks of the primitives the sampler is built from (dev tool)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+E = 61_000_000
+M = 1_048_576
+ITERS = 20
+
+
+def timed(label, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / ITERS * 1e3
+    print(f"{label:40s} {dt:8.3f} ms")
+    return out
+
+
+def scan(body):
+    def f(*args):
+        def step(c, i):
+            return body(c, i, *args), None
+        tot, _ = jax.lax.scan(step, jnp.int32(0),
+                              jnp.arange(ITERS, dtype=jnp.int32))
+        return tot
+    return jax.jit(f)
+
+
+def main():
+    key = jax.random.key(0)
+    big = jax.jit(lambda k: jax.random.randint(k, (E,), 0, 1 << 30,
+                                               dtype=jnp.int32))(key)
+    jax.block_until_ready(big)
+
+    def g_body(c, i, big):
+        idx = jax.random.randint(jax.random.fold_in(key, i), (M,), 0, E)
+        return c + jnp.sum(big[idx]) // M
+
+    timed("random gather 1M from 61M int32", scan(g_body), big)
+
+    def sort_body(c, i):
+        x = jax.random.randint(jax.random.fold_in(key, i), (M,), 0, 1 << 30,
+                               dtype=jnp.int32)
+        return c + jnp.sort(x)[0]
+
+    timed("sort 1M int32", scan(sort_body))
+
+    def argsort_body(c, i):
+        x = jax.random.randint(jax.random.fold_in(key, i), (M,), 0, 1 << 30,
+                               dtype=jnp.int32)
+        return c + argsorted(x)
+
+    def argsorted(x):
+        return jnp.argsort(x, stable=True)[0].astype(jnp.int32)
+
+    timed("argsort(stable) 1M int32", scan(argsort_body))
+
+    def sort2_body(c, i):
+        x = jax.random.randint(jax.random.fold_in(key, i), (M,), 0, 1 << 30,
+                               dtype=jnp.int32)
+        pos = jnp.arange(M, dtype=jnp.int32)
+        xs, ps = jax.lax.sort((x, pos), num_keys=1)
+        return c + xs[0] + ps[0]
+
+    timed("lax.sort 1M (key+payload)", scan(sort2_body))
+
+    def scatter_body(c, i):
+        idx = jax.random.randint(jax.random.fold_in(key, i), (M,), 0, M,
+                                 dtype=jnp.int32)
+        z = jnp.zeros((M,), jnp.int32).at[idx].set(idx)
+        return c + z[0]
+
+    timed("scatter-set 1M into 1M", scan(scatter_body))
+
+    def seg_body(c, i):
+        x = jax.random.randint(jax.random.fold_in(key, i), (M,), 0, 1 << 30,
+                               dtype=jnp.int32)
+        seg = jnp.cumsum(jnp.ones((M,), jnp.int32)) - 1
+        return c + jax.ops.segment_min(x, seg, num_segments=M)[0]
+
+    timed("segment_min 1M", scan(seg_body))
+
+    def prng_body(c, i):
+        x = jax.random.randint(jax.random.fold_in(key, i), (M,), 0, 1 << 30,
+                               dtype=jnp.int32)
+        return c + x[0]
+
+    timed("prng randint 1M", scan(prng_body))
+
+
+if __name__ == "__main__":
+    main()

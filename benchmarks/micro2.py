@@ -1,0 +1,96 @@
+"""Micro: windowed row-gather + sort-as-scatter tricks. (dev tool)"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+E = 61_000_000
+R = 180_224          # hop-2 row count
+K = 5
+W = 64               # window width
+M = 1 << 20
+ITERS = 20
+
+
+def timed(label, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / ITERS * 1e3
+    print(f"{label:45s} {dt:8.3f} ms")
+    return out
+
+
+def scan(body):
+    def f(*args):
+        def step(c, i):
+            return body(c, i, *args), None
+        tot, _ = jax.lax.scan(step, jnp.int32(0),
+                              jnp.arange(ITERS, dtype=jnp.int32))
+        return tot
+    return jax.jit(f)
+
+
+def main():
+    d = jax.devices()[0]
+    print("device:", d.device_kind, d.platform)
+    key = jax.random.key(0)
+    big = jax.jit(lambda k: jax.random.randint(k, (E,), 0, 1 << 30,
+                                               dtype=jnp.int32))(key)
+    jax.block_until_ready(big)
+
+    def win_body(c, i, big):
+        starts = jax.random.randint(jax.random.fold_in(key, i), (R,), 0,
+                                    E - W, dtype=jnp.int32)
+        wins = jax.vmap(
+            lambda s: jax.lax.dynamic_slice(big, (s,), (W,)))(starts)
+        return c + jnp.sum(wins[:, 0]) // R
+
+    timed(f"window gather {R}x{W} (vmap dyn_slice)", scan(win_body), big)
+
+    def elem_body(c, i, big):
+        idx = jax.random.randint(jax.random.fold_in(key, i), (R * K,), 0, E,
+                                 dtype=jnp.int32)
+        return c + jnp.sum(big[idx]) // R
+
+    timed(f"element gather {R * K}", scan(elem_body), big)
+
+    def elem2_body(c, i, big):
+        idx = jax.random.randint(jax.random.fold_in(key, i), (R,), 0, E,
+                                 dtype=jnp.int32)
+        return c + jnp.sum(big[idx]) // R
+
+    timed(f"element gather {R}", scan(elem2_body), big)
+
+    # scatter via sort: z[order] = vals  ==  sort (order, vals) by order
+    def scatter_body(c, i, _):
+        order = jax.random.permutation(
+            jax.random.fold_in(key, i), jnp.arange(M, dtype=jnp.int32))
+        vals = jnp.arange(M, dtype=jnp.int32)
+        z = jnp.zeros((M,), jnp.int32).at[order].set(vals)
+        return c + z[0]
+
+    timed("scatter 1M (at.set)", scan(scatter_body), big)
+
+    def sortscatter_body(c, i, _):
+        order = jax.random.permutation(
+            jax.random.fold_in(key, i), jnp.arange(M, dtype=jnp.int32))
+        vals = jnp.arange(M, dtype=jnp.int32)
+        _, z = jax.lax.sort((order, vals), num_keys=1)
+        return c + z[0]
+
+    timed("scatter 1M (sort pairs)", scan(sortscatter_body), big)
+
+
+if __name__ == "__main__":
+    main()

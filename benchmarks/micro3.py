@@ -1,0 +1,93 @@
+"""Micro: does gather locality / row width change cost? (dev tool)"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+E = 61_000_000
+R = 180_224
+K = 5
+ITERS = 20
+key = jax.random.key(0)
+
+
+def timed(label, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / ITERS * 1e3
+    print(f"{label:45s} {dt:8.3f} ms")
+    return out
+
+
+def scan(body):
+    def f(*args):
+        def step(c, i):
+            return body(c, i, *args), None
+        tot, _ = jax.lax.scan(step, jnp.int32(0),
+                              jnp.arange(ITERS, dtype=jnp.int32))
+        return tot
+    return jax.jit(f)
+
+
+def main():
+    big = jax.jit(lambda k: jax.random.randint(k, (E,), 0, 1 << 30,
+                                               dtype=jnp.int32))(key)
+    jax.block_until_ready(big)
+
+    # (a) scattered element gather, 900k
+    def a(c, i, big):
+        idx = jax.random.randint(jax.random.fold_in(key, i), (R * K,), 0, E,
+                                 dtype=jnp.int32)
+        return c + jnp.sum(big[idx]) // R
+
+    timed("gather 900k scattered", scan(a), big)
+
+    # (b) element gather, runs of 5 adjacent (same count)
+    def b(c, i, big):
+        starts = jax.random.randint(jax.random.fold_in(key, i), (R,), 0,
+                                    E - K, dtype=jnp.int32)
+        idx = (starts[:, None]
+               + jnp.arange(K, dtype=jnp.int32)[None, :]).reshape(-1)
+        return c + jnp.sum(big[idx]) // R
+
+    timed("gather 900k in runs-of-5", scan(b), big)
+
+    big2d8 = big[: (E // 8) * 8].reshape(-1, 8)
+    big2d128 = big[: (E // 128) * 128].reshape(-1, 128)
+
+    # (c) 2D row gather width 8
+    def c8(c, i, big2d8):
+        rows = jax.random.randint(jax.random.fold_in(key, i), (R,), 0,
+                                  big2d8.shape[0], dtype=jnp.int32)
+        return c + jnp.sum(big2d8[rows]) // R
+
+    timed("row gather 180k x 8", scan(c8), big2d8)
+
+    def c128(c, i, big2d128):
+        rows = jax.random.randint(jax.random.fold_in(key, i), (R,), 0,
+                                  big2d128.shape[0], dtype=jnp.int32)
+        return c + jnp.sum(big2d128[rows]) // R
+
+    timed("row gather 180k x 128", scan(c128), big2d128)
+
+    def c128b(c, i, big2d128):
+        rows = jax.random.randint(jax.random.fold_in(key, i), (16384,), 0,
+                                  big2d128.shape[0], dtype=jnp.int32)
+        return c + jnp.sum(big2d128[rows]) // R
+
+    timed("row gather 16k x 128", scan(c128b), big2d128)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Does Mosaic support a vectorized VMEM gather, and how fast? (dev tool)"""
+
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def vmem_gather_kernel(src_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take(src_ref[:], idx_ref[:], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def vmem_gather(src, idx):
+    return pl.pallas_call(
+        vmem_gather_kernel,
+        out_shape=jax.ShapeDtypeStruct(idx.shape, src.dtype),
+    )(src, idx)
+
+
+def timed(label, fn, *args, iters=50):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / iters * 1e3
+    print(f"{label:50s} {dt:8.3f} ms")
+    return out
+
+
+def main():
+    key = jax.random.key(0)
+    for src_n, idx_n in [(32768, 8192), (131072, 131072),
+                         (1 << 20, 1 << 20)]:
+        src = jax.random.randint(key, (src_n,), 0, 1 << 30, dtype=jnp.int32)
+        idx = jax.random.randint(jax.random.fold_in(key, 1), (idx_n,), 0,
+                                 src_n, dtype=jnp.int32)
+        try:
+            out = vmem_gather(src, idx)
+            ref = jnp.take(src, idx)
+            ok = bool(jnp.all(out == ref))
+            print(f"src={src_n} idx={idx_n}: correct={ok}")
+            timed(f"pallas vmem gather {idx_n} from {src_n}",
+                  vmem_gather, src, idx)
+            timed(f"XLA gather {idx_n} from {src_n}",
+                  jax.jit(lambda s, i: jnp.take(s, i)), src, idx)
+        except Exception as ex:  # noqa: BLE001
+            print(f"src={src_n} idx={idx_n}: FAILED {type(ex).__name__}: "
+                  f"{str(ex)[:300]}")
+
+
+if __name__ == "__main__":
+    main()

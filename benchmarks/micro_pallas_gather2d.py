@@ -1,0 +1,61 @@
+"""Probe Mosaic's 2D gather support forms + speed. (dev tool)"""
+
+import os
+import sys
+import time
+import functools
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def kern_axis0(src_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(src_ref[:], idx_ref[:], axis=0)
+
+
+def kern_axis1(src_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(src_ref[:], idx_ref[:], axis=1)
+
+
+def run(kern, src_shape, idx_shape, idx_max, label):
+    key = jax.random.key(0)
+    src = jax.random.randint(key, src_shape, 0, 1 << 30, dtype=jnp.int32)
+    idx = jax.random.randint(jax.random.fold_in(key, 1), idx_shape, 0,
+                             idx_max, dtype=jnp.int32)
+    f = jax.jit(lambda s, i: pl.pallas_call(
+        kern, out_shape=jax.ShapeDtypeStruct(idx_shape, src.dtype))(s, i))
+    try:
+        out = jax.block_until_ready(f(src, idx))
+        axis = 0 if kern is kern_axis0 else 1
+        ref = jnp.take_along_axis(src, idx, axis=axis)
+        ok = bool(jnp.all(out == ref))
+        t0 = time.perf_counter()
+        for _ in range(50):
+            out = jax.block_until_ready(f(src, idx))
+        dt = (time.perf_counter() - t0) / 50 * 1e3
+        n = idx.size
+        print(f"{label:45s} ok={ok} {dt:8.3f} ms "
+              f"({n / dt * 1e3 / 1e6:8.1f} M elem/s)")
+    except Exception as ex:  # noqa: BLE001
+        print(f"{label:45s} FAILED {type(ex).__name__}: {str(ex)[:200]}")
+
+
+def main():
+    run(kern_axis0, (512, 128), (512, 128), 512, "axis0 (512,128) full")
+    run(kern_axis0, (8192, 128), (8192, 128), 8192, "axis0 (8192,128)")
+    run(kern_axis0, (8192, 512), (8192, 512), 8192, "axis0 (8192,512)")
+    run(kern_axis1, (128, 512), (128, 512), 512, "axis1 (128,512)")
+    run(kern_axis1, (256, 2048), (256, 16), 2048, "axis1 (256,2048)->16")
+    run(kern_axis1, (1024, 256), (1024, 16), 256, "axis1 (1024,256)->16")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Per-stage timing of the multihop sampler on the real chip.
+
+Times each hop's sample_layer and compact_layer separately (each as one
+on-device scan of ITERS reps) to locate the bottleneck. Not part of the
+metric of record; a development tool.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from quiver_tpu.ops.sample import sample_layer, compact_layer
+
+N = 2_450_000
+AVG = 25
+ITERS = 20
+SIZES = [15, 10, 5]
+BATCH = 1024
+
+
+def timed(fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    return time.perf_counter() - t0, out
+
+
+def main():
+    key = jax.random.key(0)
+
+    @jax.jit
+    def make_graph(k):
+        ln = jax.random.normal(k, (N,)) + jnp.log(float(AVG))
+        deg = jnp.clip(jnp.exp(ln).astype(jnp.int32), 0, 10_000)
+        indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(deg)])
+        return indptr
+
+    indptr = make_graph(key)
+    e = int(indptr[-1])
+    indices = jax.jit(
+        lambda k: jax.random.randint(k, (e,), 0, N, dtype=jnp.int32)
+    )(jax.random.fold_in(key, 1))
+    jax.block_until_ready(indices)
+
+    # frontier sizes per hop (static caps)
+    fronts = [BATCH]
+    for k in SIZES:
+        fronts.append(fronts[-1] * (1 + k))
+    print("frontier caps:", fronts)
+
+    for li, k in enumerate(SIZES):
+        s = fronts[li]
+
+        def samp(indptr, indices, kk, s=s, k=k):
+            def body(c, i):
+                kb = jax.random.fold_in(kk, i)
+                seeds = jax.random.randint(kb, (s,), 0, N, dtype=jnp.int32)
+                nbrs, cnt = sample_layer(indptr, indices, seeds, k, kb)
+                return c + jnp.sum(cnt), None
+            tot, _ = jax.lax.scan(body, jnp.int32(0),
+                                  jnp.arange(ITERS, dtype=jnp.int32))
+            return tot
+
+        def comp(kk, s=s, k=k):
+            def body(c, i):
+                kb = jax.random.fold_in(kk, i)
+                seeds = jax.random.randint(kb, (s,), 0, N, dtype=jnp.int32)
+                nbrs = jax.random.randint(
+                    jax.random.fold_in(kb, 1), (s, k), -1, N,
+                    dtype=jnp.int32)
+                lay = compact_layer(seeds, nbrs)
+                return c + lay.n_count, None
+            tot, _ = jax.lax.scan(body, jnp.int32(0),
+                                  jnp.arange(ITERS, dtype=jnp.int32))
+            return tot
+
+        dt_s, _ = timed(jax.jit(samp), indptr, indices,
+                        jax.random.fold_in(key, 10 + li))
+        dt_c, _ = timed(jax.jit(comp), jax.random.fold_in(key, 20 + li))
+        print(f"hop {li} (s={s:>7}, k={k:>2}): "
+              f"sample {dt_s / ITERS * 1e3:8.2f} ms   "
+              f"compact {dt_c / ITERS * 1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,9 @@
 from .sample import (
     sample_layer,
+    sample_layer_rotation,
+    permute_csr,
+    as_index_rows,
+    edge_row_ids,
     compact_layer,
     sample_prob_step,
     sample_prob,
@@ -13,6 +17,10 @@ from .weighted import (
 
 __all__ = [
     "sample_layer",
+    "sample_layer_rotation",
+    "permute_csr",
+    "as_index_rows",
+    "edge_row_ids",
     "compact_layer",
     "sample_prob_step",
     "sample_prob",

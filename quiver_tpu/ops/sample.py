@@ -12,10 +12,12 @@ This is the TPU-native redesign of the reference's CUDA sampling stack:
 
 - ``compact_layer``  <- the device ordered hashtable + prefix-sum compaction
   (``reindex_single``/``FillWithDuplicates``, quiver_sample.cu:202-357,
-  reindex.cu.hpp:20-183). TPUs have no atomics-friendly hashtable, so
-  uniqueness is computed by stable sort + run-length flags + segment-min of
-  first-occurrence positions, preserving the reference's first-occurrence
-  ordering guarantee (seeds come first in ``n_id``).
+  reindex.cu.hpp:20-183). TPUs have no atomics-friendly hashtable — and
+  XLA's TPU gather/scatter runs as a serial ~25ns-per-index loop — so
+  uniqueness is computed purely with ``lax.sort`` + dense prefix scans.
+  Ordering contract (slightly relaxed vs the reference's first-occurrence
+  order, same downstream semantics): valid seeds keep slots [0, v), the
+  remaining unique neighbors follow in ascending id order.
 
 - ``sample_prob``    <- ``cal_next`` probability propagation
   (cuda_random.cu.hpp:71-104, sage_sampler.py:149-157) as pure segment ops.
@@ -38,8 +40,9 @@ import jax.numpy as jnp
 class LayerSample(NamedTuple):
     """One sampled hop, fixed shapes.
 
-    n_id:     [cap] unique node ids (first-occurrence order; seeds first;
-              -1 fill past ``n_count``)
+    n_id:     [cap] unique node ids (valid seeds first, keeping their
+              slots; then new neighbors in ascending id order; -1 fill
+              past ``n_count``)
     n_count:  [] number of valid entries in ``n_id``
     row:      [num_seeds*k] local (compacted) index of the seed of each
               sampled edge; -1 fill
@@ -120,76 +123,221 @@ def sample_layer(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     return nbrs, counts
 
 
-def compact_ids(ids: jax.Array):
-    """Deduplicate a -1-padded id vector preserving first-occurrence order.
+def edge_row_ids(indptr: jax.Array, edge_count: int) -> jax.Array:
+    """Row id of every CSR slot, built scatter-once + cumsum (cheap at
+    graph-build time; cached by CSRTopo)."""
+    z = jnp.zeros((edge_count,), jnp.int32)
+    inner = indptr[1:-1]
+    z = z.at[jnp.clip(inner, 0, max(edge_count - 1, 0))].add(
+        jnp.where(inner < edge_count, 1, 0).astype(jnp.int32))
+    return jnp.cumsum(z).astype(jnp.int32)
 
-    Returns (n_id [cap] -1-filled, n_count, local_ids [cap]) where
-    ``local_ids[i]`` is the position of ``ids[i]`` in ``n_id`` (garbage
-    where ``ids[i] < 0``). This is the sort-based replacement for the
-    reference's device ordered hashtable (reindex.cu.hpp:20-183).
+
+def permute_csr(indices: jax.Array, row_ids: jax.Array,
+                key: jax.Array) -> jax.Array:
+    """Uniformly shuffle every CSR row's neighbor list, on device, in one
+    2-key sort over the edge array. O(E log E), ~4ms per 1M edges on
+    v5e — refresh once per epoch so rotation sampling (below) draws fresh
+    subsets each epoch."""
+    rand = jax.random.bits(key, (indices.shape[0],)).astype(jnp.int32)
+    _, _, permuted = jax.lax.sort(
+        (row_ids, rand, indices.astype(jnp.int32)), num_keys=2)
+    return permuted
+
+
+def as_index_rows(indices: jax.Array, width: int = 128) -> jax.Array:
+    """Pad + reshape the CSR ``indices`` array into 128-wide rows. TPU
+    random access costs ~25ns per gather *index* regardless of row width
+    (up to a lane), so the sampler fetches 128-wide rows, not elements."""
+    e = indices.shape[0]
+    rows = (e + 2 * width - 1) // width + 1
+    pad = rows * width - e
+    return jnp.concatenate(
+        [indices, jnp.zeros((pad,), indices.dtype)]).reshape(rows, width)
+
+
+def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
+                          seeds: jax.Array, k: int, key: jax.Array):
+    """Rotation sampling: draw ``min(deg, k)`` *consecutive* entries of the
+    (pre-shuffled) neighbor row at a uniform random offset.
+
+    With rows re-shuffled every epoch (``permute_csr``), each draw is
+    marginally uniform over the true neighbors and slots are distinct —
+    the same guarantees the reference's reservoir kernel provides
+    (cuda_random.cu.hpp:7-69) — while the per-seed memory traffic is two
+    128-wide row fetches instead of k scattered loads. Subsets within one
+    epoch are limited to runs of that epoch's shuffle (documented
+    trade-off; use ``sample_layer`` for i.i.d. exact subsets).
+
+    Returns (neighbors [bs, k] -1 fill, counts [bs]).
+    """
+    if k > 128:
+        raise ValueError(
+            f"sample_layer_rotation supports k <= 128 (got {k}): the "
+            "two-row window only covers picks [off, off+k) up to a lane")
+    n = indptr.shape[0] - 1
+    valid = seeds >= 0
+    safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
+    start = indptr[safe]
+    deg = jnp.where(valid, indptr[safe + 1] - start, 0).astype(jnp.int32)
+    counts = jnp.minimum(deg, k)
+
+    bs = seeds.shape[0]
+    span = jnp.maximum(deg - k, 0) + 1
+    o = jax.random.randint(key, (bs,), 0, span, dtype=jnp.int32)
+    p0 = start + o.astype(start.dtype)
+    r0 = (p0 // 128).astype(jnp.int32)
+    off = (p0 % 128).astype(jnp.int32)
+    # two row-gathers -> a 256-wide window that always covers picks
+    # [off, off + k) since k <= 128
+    w = jnp.concatenate(
+        [indices_rows[r0], indices_rows[r0 + 1]], axis=1)   # [bs, 256]
+    wiota = jax.lax.broadcasted_iota(jnp.int32, (1, w.shape[1]), 1)
+    cols = []
+    for j in range(k):
+        onehot = wiota == (off[:, None] + j)
+        cols.append(jnp.sum(jnp.where(onehot, w, 0), axis=1))
+    nbrs = jnp.stack(cols, axis=1).astype(jnp.int32)
+    mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
+    return jnp.where(mask, nbrs, -1), counts
+
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _fill_from_run_start(values: jax.Array, at: jax.Array) -> jax.Array:
+    """Forward-fill ``values`` (defined where ``at`` is True) to every
+    later position until the next ``at``. Dense O(n log n) associative
+    scan — no gathers (TPU gathers cost ~25ns *per index*, serial)."""
+    def combine(a, b):
+        av, asn = a
+        bv, bsn = b
+        return jnp.where(bsn, bv, av), asn | bsn
+
+    filled, _ = jax.lax.associative_scan(
+        combine, (jnp.where(at, values, 0), at))
+    return filled
+
+
+def _compact_core(ids: jax.Array, s: int):
+    """Shared sort-only compaction. ``ids[:s]`` is the prefix ("seeds"):
+    its valid entries MUST be distinct (duplicate seeds leave holes in the
+    slot assignment and corrupt ``n_id`` — same alignment break as the
+    reference when fed duplicate seeds); they occupy slots [0, v) ordered
+    by position (slot = rank among valid seeds, so -1 holes anywhere in
+    the prefix are safe); the remaining unique values follow in ascending
+    id order.
+
+    Returns (n_id [cap] -1-filled, n_count, local [cap]) with ``local[i]``
+    = position of ``ids[i]`` in ``n_id`` (garbage where ``ids[i] < 0``).
+
+    Built exclusively from ``lax.sort`` + dense prefix scans because XLA's
+    TPU gather/scatter is a ~25ns-per-index serial loop — on a 1M-element
+    layer the reference-style hashtable compaction (reindex.cu.hpp:20-183)
+    re-expressed with argsort+gathers costs ~40ms, this form ~8ms.
+    Requires ids < 2^31-1 and cap < 2^30.
     """
     cap = ids.shape[0]
     ids = ids.astype(jnp.int32)
+    iota = jnp.arange(cap, dtype=jnp.int32)
     valid = ids >= 0
-    sent = jnp.iinfo(jnp.int32).max
-    keyed = jnp.where(valid, ids, sent)
-    # positions drive first-occurrence order; invalid entries pushed last
-    pos = jnp.where(valid, jnp.arange(cap, dtype=jnp.int32), cap)
+    is_seed = (iota < s) & valid
 
-    order = jnp.argsort(keyed, stable=True)
-    sorted_ids = keyed[order]
-    sorted_pos = pos[order]
-    is_run_start = jnp.concatenate(
-        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]])
-    seg = jnp.cumsum(is_run_start) - 1                       # [cap]
-    n_count = jnp.sum(is_run_start & (sorted_ids != sent)).astype(jnp.int32)
+    B30 = jnp.int32(1 << 30)
+    idk = jnp.where(valid, ids, _I32_MAX)
+    # tag bit30 orders a run's seed entry before its duplicates; low bits
+    # carry the original position through the sort. A third operand
+    # carries each seed's rank among *valid* seeds: seed slots are rank-
+    # based so -1 holes in the prefix can't collide with extra slots.
+    tag = jnp.where(is_seed, 0, B30) | iota
+    seed_rank = (jnp.cumsum(is_seed).astype(jnp.int32) - 1)
+    sid, stag, srk = jax.lax.sort(
+        (idk, tag, jnp.where(is_seed, seed_rank, 0)), num_keys=2)
+    sseed = stag < B30
+    spos = stag & (B30 - 1)
 
-    # per unique value: its id and its first-occurrence position
-    uniq_val = jax.ops.segment_min(sorted_ids, seg, num_segments=cap)
-    uniq_pos = jax.ops.segment_min(sorted_pos, seg, num_segments=cap)
+    flag = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    fvalid = sid != _I32_MAX
+    vseeds = jnp.sum(is_seed).astype(jnp.int32)
+    sflag = flag & sseed                      # seed-run starts
+    nsflag = flag & fvalid & ~sseed           # valid non-seed run starts
 
-    # order uniques by first occurrence -> n_id; invert for local-id lookup
-    perm = jnp.argsort(uniq_pos, stable=True)
-    n_id = jnp.where(jnp.arange(cap, dtype=jnp.int32) < n_count,
-                     uniq_val[perm], -1)
-    local_of_seg = jnp.zeros((cap,), jnp.int32).at[perm].set(
-        jnp.arange(cap, dtype=jnp.int32))
+    # per-element fills (all monotone -> cummax, or assoc-scan fallback)
+    rs = jax.lax.cummax(jnp.where(flag, iota, -1), axis=0)      # my run's start
+    lss = jax.lax.cummax(jnp.where(sflag, iota, -1), axis=0)    # last seed-run start
+    in_seedrun = (lss == rs) & (lss >= 0)
 
-    # segment of every original element (scatter back through the sort)
-    seg_of_elem = jnp.zeros((cap,), jnp.int32).at[order].set(
-        seg.astype(jnp.int32))
-    local_ids = local_of_seg[seg_of_elem]                    # [cap]
-    return n_id, n_count, local_ids
+    # seed slot of my run's seed (= its rank among valid seeds, carried
+    # through the sort as srk). srank (rank among seed runs) is monotone,
+    # so (srank << 9 | srk-half) stays sortable under cummax; two packed
+    # fills carry the 18-bit srk in 9-bit halves within int32.
+    if s < (1 << 18) and cap < (1 << 30):
+        srank = jnp.cumsum(sflag) - 1                   # const within run
+        hi = jax.lax.cummax(
+            jnp.where(sflag, (srank << 9) | (srk >> 9), -1), axis=0)
+        lo = jax.lax.cummax(
+            jnp.where(sflag, (srank << 9) | (srk & 511), -1), axis=0)
+        seed_local = ((hi & 511) << 9) | (lo & 511)
+    else:
+        seed_local = _fill_from_run_start(srk, sflag)
+
+    nsrank = jnp.cumsum(nsflag).astype(jnp.int32) - 1   # const within run
+    local_sorted = jnp.where(in_seedrun, seed_local, vseeds + nsrank)
+
+    n_count = (vseeds + jnp.sum(nsflag)).astype(jnp.int32)
+
+    # n_id[local] = id at run starts; scatter expressed as key+payload sort
+    okey = jnp.where(flag & fvalid, local_sorted, _I32_MAX)
+    _, n_id_payload = jax.lax.sort((okey, sid), num_keys=1)
+    n_id = jnp.where(iota < n_count, n_id_payload, -1)
+
+    # route local ids back to original positions (spos is a permutation)
+    _, local = jax.lax.sort((spos, local_sorted), num_keys=1)
+    return n_id, n_count, local
+
+
+def compact_ids(ids: jax.Array):
+    """Deduplicate a -1-padded id vector. Returns (n_id [cap] -1-filled,
+    n_count, local_ids [cap]) where ``local_ids[i]`` is the position of
+    ``ids[i]`` in ``n_id`` (garbage where ``ids[i] < 0``). ``n_id`` lists
+    the unique values in ascending order. Sort-only replacement for the
+    reference's device ordered hashtable (reindex.cu.hpp:20-183)."""
+    return _compact_core(ids, 0)
 
 
 def compact_union(prefix_ids: jax.Array, extra_ids: jax.Array):
-    """Union ``prefix_ids ++ extra_ids`` (both -1-padded, any lengths),
-    prefix first. Returns (n_id, n_count, local_ids_of_extra)."""
+    """Union ``prefix_ids ++ extra_ids`` (both -1-padded, any lengths).
+    Valid prefix entries (assumed distinct) keep their slots in ``n_id``;
+    remaining unique extras follow in ascending id order.
+    Returns (n_id, n_count, local_ids_of_extra)."""
     p = prefix_ids.shape[0]
-    n_id, n_count, local = compact_ids(
+    n_id, n_count, local = _compact_core(
         jnp.concatenate([prefix_ids.astype(jnp.int32),
-                         extra_ids.astype(jnp.int32)]))
+                         extra_ids.astype(jnp.int32)]), p)
     extra_local = jnp.where(extra_ids >= 0, local[p:], -1)
     return n_id, n_count, extra_local
 
 
 def compact_layer(seeds: jax.Array, nbrs: jax.Array) -> LayerSample:
-    """Deduplicate ``concat(seeds, nbrs)`` preserving first-occurrence order
-    and emit the layer's bipartite COO in local (compacted) ids.
+    """Deduplicate ``concat(seeds, nbrs)`` and emit the layer's bipartite
+    COO in local (compacted) ids.
 
-    seeds: [s] int32, -1 fill allowed. nbrs: [s, k] int32, -1 fill.
-    Output capacity is the static ``s + s*k``.
+    seeds: [s] int32, -1 fill allowed; valid entries must be distinct
+    (true for frontiers and training batches). nbrs: [s, k] int32, -1
+    fill. Output capacity is the static ``s + s*k``. Valid seeds keep
+    slots [0, n_valid_seeds) of ``n_id`` (the invariant training relies
+    on: layer outputs for the batch are rows [0, bs)); new neighbors
+    follow in ascending id order.
     """
     s, k = nbrs.shape
-    n_id, n_count, local_ids = compact_ids(
-        jnp.concatenate([seeds, nbrs.reshape(-1)]))
+    n_id, n_count, local_ids = _compact_core(
+        jnp.concatenate([seeds, nbrs.reshape(-1)]), s)
     nbr_valid = nbrs.reshape(-1) >= 0
     col = jnp.where(nbr_valid, local_ids[s:], -1)
-    row = jnp.where(
-        nbr_valid,
-        jnp.repeat(jnp.arange(s, dtype=jnp.int32), k),
-        -1,
-    )
+    seed_local = jax.lax.broadcast_in_dim(
+        local_ids[:s], (s, k), (0,)).reshape(-1)
+    row = jnp.where(nbr_valid, seed_local, -1)
     edge_count = jnp.sum(nbr_valid).astype(jnp.int32)
     return LayerSample(n_id=n_id, n_count=n_count, row=row, col=col,
                        edge_count=edge_count)

@@ -9,29 +9,44 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .sample import LayerSample, compact_layer, sample_layer
+from .sample import (LayerSample, as_index_rows, compact_layer, sample_layer,
+                     sample_layer_rotation)
 from .weighted import sample_layer_weighted
 
 
 def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                     sizes: Sequence[int], key: jax.Array,
                     edge_weight: jax.Array | None = None,
+                    method: str = "exact",
+                    indices_rows: jax.Array | None = None,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
     sampling order (innermost target hop first).
 
+    ``method``: ``"exact"`` (default; i.i.d. Fisher-Yates subsets, k
+    scattered loads per seed) or ``"rotation"`` (~3x faster on TPU: two
+    128-wide row fetches per seed; REQUIRES the caller to shuffle rows
+    with ``permute_csr`` — at least once, ideally per epoch — or endpoint
+    neighbors are under-sampled; pass the shuffled array as ``indices``
+    and its ``as_index_rows`` view as ``indices_rows``).
     ``edge_weight`` (CSR-slot-aligned) switches every hop to weighted
-    sampling."""
+    sampling (always exact).
+    """
     cur = seeds.astype(jnp.int32)
+    if edge_weight is None and method == "rotation" and indices_rows is None:
+        indices_rows = as_index_rows(indices)
     layers: List[LayerSample] = []
     for i, k in enumerate(sizes):
         sub = jax.random.fold_in(key, i)
-        if edge_weight is None:
-            nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
-        else:
+        if edge_weight is not None:
             nbrs, _ = sample_layer_weighted(indptr, indices, edge_weight,
                                             cur, k, sub)
+        elif method == "rotation":
+            nbrs, _ = sample_layer_rotation(indptr, indices_rows, cur, k,
+                                            sub)
+        else:
+            nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
         layer = compact_layer(cur, nbrs)
         layers.append(layer)
         cur = layer.n_id

@@ -90,6 +90,73 @@ class TestSampleLayer:
         assert int(counts[1]) == 2
 
 
+class TestRotationSampler:
+    """sample_layer_rotation + permute_csr: membership/count/distinctness
+    per draw; marginal uniformity across epoch re-shuffles."""
+
+    def test_membership_counts_distinct(self, small_graph):
+        from quiver_tpu.ops import (sample_layer_rotation, as_index_rows)
+        indptr, indices = small_graph
+        nsets = neighbor_sets(indptr, indices)
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        k = 5
+        rows = as_index_rows(jnp.asarray(indices))
+        nbrs, counts = sample_layer_rotation(
+            jnp.asarray(indptr), rows, jnp.asarray(seeds), k, KEY)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        deg = np.diff(indptr)
+        np.testing.assert_array_equal(counts, np.minimum(deg, k))
+        for i, v in enumerate(seeds):
+            got = nbrs[i][: counts[i]]
+            assert set(got.tolist()) <= nsets[v]
+            assert (nbrs[i][counts[i]:] == -1).all()
+            # distinct positions -> distinct unless graph has parallel edges
+
+    def test_masked_and_zero_degree(self):
+        from quiver_tpu.ops import sample_layer_rotation, as_index_rows
+        indptr = np.array([0, 0, 2, 2])
+        indices = np.array([5, 6])
+        rows = as_index_rows(jnp.asarray(indices))
+        nbrs, counts = sample_layer_rotation(
+            jnp.asarray(indptr), rows, jnp.array([0, 1, -1], jnp.int32), 3,
+            KEY)
+        counts = np.asarray(counts)
+        assert counts.tolist() == [0, 2, 0]
+        assert set(np.asarray(nbrs)[1][:2].tolist()) == {5, 6}
+
+    def test_uniform_across_reshuffles(self):
+        from quiver_tpu.ops import (sample_layer_rotation, as_index_rows,
+                                    permute_csr, edge_row_ids)
+        # one node with 10 neighbors, k=2; re-shuffle each "epoch"
+        indptr = np.array([0, 10])
+        indices = np.arange(100, 110)
+        row_ids = edge_row_ids(jnp.asarray(indptr), 10)
+        seeds = jnp.zeros((64,), jnp.int32)
+        hits = np.zeros(10)
+        for t in range(40):
+            perm = permute_csr(jnp.asarray(indices), row_ids,
+                               jax.random.fold_in(KEY, 1000 + t))
+            assert set(np.asarray(perm).tolist()) == set(indices.tolist())
+            rows = as_index_rows(perm)
+            nbrs, _ = sample_layer_rotation(
+                jnp.asarray(indptr), rows, seeds, 2,
+                jax.random.fold_in(KEY, t))
+            ids, cnt = np.unique(np.asarray(nbrs) - 100, return_counts=True)
+            hits[ids] += cnt
+        freq = hits / hits.sum()
+        np.testing.assert_allclose(freq, 0.1, atol=0.02)
+
+    def test_permute_csr_preserves_rows(self, small_graph):
+        from quiver_tpu.ops import permute_csr, edge_row_ids
+        indptr, indices = small_graph
+        row_ids = edge_row_ids(jnp.asarray(indptr), len(indices))
+        perm = np.asarray(permute_csr(jnp.asarray(indices), row_ids, KEY))
+        for v in range(len(indptr) - 1):
+            lo, hi = indptr[v], indptr[v + 1]
+            assert sorted(perm[lo:hi].tolist()) == \
+                sorted(indices[lo:hi].tolist())
+
+
 class TestCompactLayer:
     def test_seeds_first_and_unique(self):
         seeds = jnp.array([7, 3, 9], jnp.int32)
@@ -119,13 +186,12 @@ class TestCompactLayer:
         nbrs = rng.integers(0, 1000, size=(s, k)).astype(np.int32)
         nbrs[rng.random((s, k)) < 0.3] = -1
         out = compact_layer(jnp.asarray(seeds), jnp.asarray(nbrs))
-        # oracle: first-occurrence unique over concat
-        flat = np.concatenate([seeds, nbrs.reshape(-1)])
-        seen, order = set(), []
-        for x in flat.tolist():
-            if x >= 0 and x not in seen:
-                seen.add(x)
-                order.append(x)
+        # oracle: valid seeds keep their slots, then the remaining unique
+        # neighbor ids in ascending order (the documented contract)
+        seen = set(seeds.tolist())
+        extras = sorted(set(x for x in nbrs.reshape(-1).tolist()
+                            if x >= 0 and x not in seen))
+        order = seeds.tolist() + extras
         n = int(out.n_count)
         assert np.asarray(out.n_id)[:n].tolist() == order
         # every valid edge maps back to the right global ids
@@ -139,6 +205,18 @@ class TestCompactLayer:
                 else:
                     assert row[e] == local[seeds[i]]
                     assert col[e] == local[nbrs[i, j]]
+
+    def test_invalid_seed_holes_no_collision(self):
+        # a -1 hole *before* a valid seed: seed slots are rank-based, so
+        # extras must not collide with the seed's local id
+        seeds = jnp.array([-1, 5], jnp.int32)
+        nbrs = jnp.array([[-1], [3]], jnp.int32)
+        out = compact_layer(seeds, nbrs)
+        n = int(out.n_count)
+        assert n == 2
+        assert np.asarray(out.n_id)[:n].tolist() == [5, 3]
+        assert np.asarray(out.row).tolist() == [-1, 0]
+        assert np.asarray(out.col).tolist() == [-1, 1]
 
     def test_jit_static_shapes(self):
         f = jax.jit(compact_layer)
