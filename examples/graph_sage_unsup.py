@@ -1,0 +1,181 @@
+"""Unsupervised GraphSAGE: link-prediction loss with random-walk positives.
+
+TPU-native equivalent of the reference workflow in
+examples/pyg/graph_sage_unsup_quiver.py: for each batch of nodes draw a
+1-step random-walk positive and a uniform negative, sample the k-hop
+neighborhood of the tripled batch, and minimize
+-log sigma(z_u . z_pos) - log sigma(-z_u . z_neg).
+
+Runs on a synthetic community graph (no dataset download in this
+environment); prints link-prediction AUC on held-out edges, which rises
+well above 0.5 as the embeddings learn the community structure.
+
+Usage: python examples/graph_sage_unsup.py [--nodes N] [--epochs E]
+On CPU: JAX_PLATFORMS=cpu python examples/graph_sage_unsup.py
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_community_graph(rng, n, communities=16, p_in=0.02, p_out=0.0005,
+                         dim=64):
+    """Sparse SBM-ish graph + community-correlated features."""
+    comm = rng.integers(0, communities, n)
+    src, dst = [], []
+    # sample edges community-blockwise to stay sparse
+    for c in range(communities):
+        members = np.flatnonzero(comm == c)
+        m = len(members)
+        deg_in = max(1, int(p_in * m))
+        for _ in range(deg_in):
+            src.append(members)
+            dst.append(rng.choice(members, m))
+    deg_out = max(1, int(p_out * n))
+    all_nodes = np.arange(n)
+    for _ in range(deg_out):
+        src.append(all_nodes)
+        dst.append(rng.integers(0, n, n))
+    src = np.concatenate(src)
+    dst = np.concatenate(dst)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    # symmetrize
+    edge_index = np.stack([np.concatenate([src, dst]),
+                           np.concatenate([dst, src])])
+    base = rng.standard_normal((communities, dim)) * 0.5
+    feat = (base[comm] + rng.standard_normal((n, dim))).astype(np.float32)
+    # row-normalize like the reference's T.NormalizeFeatures() — keeps
+    # dot-product logits in a stable range for the sigmoid loss
+    feat /= np.maximum(np.linalg.norm(feat, axis=1, keepdims=True), 1e-6)
+    return edge_index, feat, comm
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=10000)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--hidden", type=int, default=64)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from quiver_tpu import CSRTopo
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.ops.sample_multihop import sample_multihop_dedup
+    from quiver_tpu.ops.random_walk import random_walk_step
+    from quiver_tpu.parallel.train import (TrainState, layers_to_adjs,
+                                           masked_feature_gather)
+
+    rng = np.random.default_rng(0)
+    edge_index, feat_np, comm = make_community_graph(rng, args.nodes)
+    topo = CSRTopo(edge_index=jnp.asarray(edge_index))
+    indptr = jnp.asarray(topo.indptr)
+    indices = jnp.asarray(topo.indices)
+    feat = jnp.asarray(feat_np)
+    sizes = [10, 10]
+    bs = args.batch
+    tri = 3 * bs                     # [batch | positives | negatives]
+
+    model = GraphSAGE(hidden_dim=args.hidden, out_dim=args.hidden,
+                      num_layers=2, dropout=0.0)
+    tx = optax.adam(1e-3)
+
+    def unsup_loss(params, feat, indptr, indices, seeds, key):
+        pos = random_walk_step(indptr, indices, seeds,
+                               jax.random.fold_in(key, 1))
+        neg = jax.random.randint(jax.random.fold_in(key, 2), (bs,), 0,
+                                 args.nodes, dtype=jnp.int32)
+        # the triple may contain duplicates (pos/neg can hit seeds) ->
+        # dedup + map outputs back through batch_locals
+        batch = jnp.concatenate([seeds, pos, neg])
+        n_id, layers, blocals = sample_multihop_dedup(
+            indptr, indices, batch, sizes, jax.random.fold_in(key, 3))
+        x = masked_feature_gather(feat, n_id)
+        adjs = layers_to_adjs(layers, tri, sizes)
+        z = model.apply(params, x, adjs)[:tri]
+        z = z[blocals]
+        zu, zp, zn = z[:bs], z[bs:2 * bs], z[2 * bs:]
+        pos_logit = jnp.sum(zu * zp, axis=1)
+        neg_logit = jnp.sum(zu * zn, axis=1)
+        return -(jax.nn.log_sigmoid(pos_logit).mean()
+                 + jax.nn.log_sigmoid(-neg_logit).mean())
+
+    @jax.jit
+    def step(state, feat, indptr, indices, seeds, key):
+        loss, grads = jax.value_and_grad(unsup_loss)(
+            state.params, feat, indptr, indices, seeds, key)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    # init (dedup: the tripled arange violates the distinct-seeds contract)
+    key = jax.random.key(0)
+    seeds0 = jnp.arange(bs, dtype=jnp.int32)
+    n_id, layers, _ = sample_multihop_dedup(
+        indptr, indices, jnp.concatenate([seeds0] * 3), sizes, key)
+    x0 = masked_feature_gather(feat, n_id)
+    adjs0 = layers_to_adjs(layers, tri, sizes)
+    params = model.init(jax.random.key(1), x0, adjs0)
+    state = TrainState(params, tx.init(params), jnp.int32(0))
+
+    # held-out eval edges + random non-edges for AUC
+    eval_pos = edge_index[:, rng.choice(edge_index.shape[1], 2000,
+                                        replace=False)]
+    eval_neg = rng.integers(0, args.nodes, (2, 2000))
+
+    @jax.jit
+    def embed(params, feat, indptr, indices, nodes, key):
+        n_id, layers = sample_multihop(indptr, indices, nodes, sizes, key)
+        x = masked_feature_gather(feat, n_id)
+        adjs = layers_to_adjs(layers, nodes.shape[0], sizes)
+        return model.apply(params, x, adjs)[: nodes.shape[0]]
+
+    def auc(state, key):
+        zs = []
+        all_nodes = np.unique(np.concatenate(
+            [eval_pos.reshape(-1), eval_neg.reshape(-1)]))
+        lut = {g: i for i, g in enumerate(all_nodes)}
+        pad = (-len(all_nodes)) % bs
+        padded = np.concatenate([all_nodes, np.zeros(pad, np.int64)])
+        for i in range(0, len(padded), bs):
+            zs.append(np.asarray(embed(
+                state.params, feat, indptr, indices,
+                jnp.asarray(padded[i:i + bs], jnp.int32),
+                jax.random.fold_in(key, i))))
+        z = np.concatenate(zs)[: len(all_nodes)]
+        def score(pairs):
+            a = z[[lut[g] for g in pairs[0]]]
+            b = z[[lut[g] for g in pairs[1]]]
+            return (a * b).sum(1)
+        sp, sn = score(eval_pos), score(eval_neg)
+        # AUC = P(pos score > neg score)
+        return (sp[:, None] > sn[None, :]).mean()
+
+    train_nodes = np.arange(args.nodes)
+    steps_per_epoch = args.nodes // bs
+    for epoch in range(args.epochs):
+        rng.shuffle(train_nodes)
+        t0, tot = time.time(), 0.0
+        for i in range(steps_per_epoch):
+            seeds = jnp.asarray(
+                train_nodes[i * bs:(i + 1) * bs], jnp.int32)
+            state, loss = step(state, feat, indptr, indices, seeds,
+                               jax.random.fold_in(key, epoch * 10000 + i))
+            tot += float(loss)
+        a = auc(state, jax.random.fold_in(key, 999))
+        print(f"epoch {epoch}: loss {tot / steps_per_epoch:.4f}  "
+              f"link-AUC {a:.3f}  {time.time() - t0:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

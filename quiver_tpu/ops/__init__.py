@@ -9,7 +9,8 @@ from .sample import (
     sample_prob,
     LayerSample,
 )
-from .sample_multihop import sample_multihop
+from .sample_multihop import sample_multihop, sample_multihop_dedup
+from .random_walk import random_walk, random_walk_step
 from .weighted import (
     sample_layer_weighted,
     csr_weights_from_eid,
@@ -25,6 +26,9 @@ __all__ = [
     "sample_prob_step",
     "sample_prob",
     "sample_multihop",
+    "sample_multihop_dedup",
+    "random_walk",
+    "random_walk_step",
     "sample_layer_weighted",
     "csr_weights_from_eid",
     "LayerSample",

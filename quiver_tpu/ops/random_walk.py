@@ -1,0 +1,51 @@
+"""Uniform random walks on the CSR topology.
+
+The reference's unsupervised GraphSAGE example draws 1-step walks with
+``torch_cluster.random_walk`` for positive pairs
+(examples/pyg/graph_sage_unsup_quiver.py:50-52); this provides the same
+capability device-side: walks are one `lax.scan` over hops, each hop one
+uniform-neighbor pick per walker (a single gather per walker, static
+shapes, explicit PRNG).
+
+Walkers stuck on zero-degree nodes stay in place (torch_cluster pads the
+same way: the walk repeats the node).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_walk_step(indptr: jax.Array, indices: jax.Array,
+                     cur: jax.Array, key: jax.Array) -> jax.Array:
+    """One uniform-neighbor hop for every walker. cur [w] int32 (-1
+    allowed, stays -1). Returns next [w] int32."""
+    n = indptr.shape[0] - 1
+    e = indices.shape[0]
+    valid = cur >= 0
+    safe = jnp.clip(cur, 0, max(n - 1, 0)).astype(indptr.dtype)
+    start = indptr[safe]
+    deg = jnp.where(valid, indptr[safe + 1] - start, 0).astype(jnp.int32)
+    r = jax.random.randint(key, cur.shape, 0, jnp.maximum(deg, 1),
+                           dtype=jnp.int32)
+    pos = jnp.clip(start + r.astype(start.dtype), 0, max(e - 1, 0))
+    nxt = indices[pos].astype(jnp.int32)
+    # stuck (deg==0) walkers stay; invalid stay -1
+    nxt = jnp.where(deg > 0, nxt, cur)
+    return jnp.where(valid, nxt, -1)
+
+
+def random_walk(indptr: jax.Array, indices: jax.Array, starts: jax.Array,
+                walk_length: int, key: jax.Array) -> jax.Array:
+    """Uniform random walks. Returns [w, walk_length + 1] int32 paths,
+    ``paths[:, 0] == starts``."""
+    starts = starts.astype(jnp.int32)
+
+    def body(cur, k):
+        nxt = random_walk_step(indptr, indices, cur, k)
+        return nxt, nxt
+
+    keys = jax.random.split(key, walk_length)
+    _, steps = jax.lax.scan(body, starts, keys)
+    return jnp.concatenate([starts[None, :], steps]).T

@@ -51,3 +51,21 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
         layers.append(layer)
         cur = layer.n_id
     return cur, layers
+
+
+def sample_multihop_dedup(indptr: jax.Array, indices: jax.Array,
+                          batch: jax.Array, sizes: Sequence[int],
+                          key: jax.Array, **kwargs):
+    """`sample_multihop` for batches that may contain DUPLICATE ids (e.g.
+    the unsupervised [seeds | walk-positives | negatives] triple,
+    reference examples/pyg/graph_sage_unsup_quiver.py:56-58). The batch is
+    deduplicated first (the compaction contract requires distinct seeds);
+    returns (n_id, layers, batch_locals) where ``batch_locals[i]`` is the
+    row of ``batch[i]`` in the model output — the collapse semantics of
+    the reference's first-occurrence hashtable."""
+    from .sample import compact_ids
+
+    ubatch, _, blocals = compact_ids(batch.astype(jnp.int32))
+    n_id, layers = sample_multihop(indptr, indices, ubatch, sizes, key,
+                                   **kwargs)
+    return n_id, layers, blocals

@@ -89,7 +89,7 @@ class GraphSageSampler:
 
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int],
                  device=None, mode: str = "HBM", seed: int = 0,
-                 edge_weight=None):
+                 edge_weight=None, sampling: str = "exact"):
         if mode not in ("HBM", "HOST", "CPU", "UVA", "GPU"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         # accept reference mode names: UVA -> HOST tier, GPU -> HBM
@@ -103,9 +103,23 @@ class GraphSageSampler:
         self.edge_weight = edge_weight
         if edge_weight is not None and mode == "CPU":
             raise ValueError("weighted sampling runs on the device path")
+        # sampling="rotation": ~3x faster device path (two 128-wide row
+        # fetches per seed over a shuffled CSR copy instead of k scattered
+        # loads). The sampler shuffles once at init; call reshuffle() at
+        # each epoch boundary so draws stay marginally uniform.
+        if sampling not in ("exact", "rotation"):
+            raise ValueError(f"unknown sampling method {sampling!r}")
+        if sampling == "rotation" and (
+                edge_weight is not None or mode == "CPU"):
+            sampling = "exact"   # those paths have their own samplers
+        if sampling == "rotation" and max(sizes, default=0) > 128:
+            raise ValueError("rotation sampling supports fanouts <= 128")
+        self.sampling = sampling
         self._key = jax.random.key(seed)
         self._placed = None
         self._weight_placed = None
+        self._rot = None          # (permuted_indices, index_rows)
+        self._row_ids = None
         self._fns = {}
 
     # -- placement ----------------------------------------------------------
@@ -136,15 +150,44 @@ class GraphSageSampler:
                       jax.device_put(self.csr_topo.indices, dev))
         self._placed = placed
 
+    def reshuffle(self, key=None):
+        """Re-shuffle every CSR row's neighbor order (rotation sampling's
+        freshness source). Called automatically on first sample; call at
+        each epoch boundary thereafter. ~4ms/1M edges."""
+        from ..ops.sample import as_index_rows, edge_row_ids, permute_csr
+        self.lazy_init_quiver()
+        indptr, indices = self._placed
+        indptr = jnp.asarray(indptr)
+        indices = jnp.asarray(indices)
+        if self._row_ids is None:
+            self._row_ids = jax.jit(edge_row_ids, static_argnums=1)(
+                indptr, int(indices.shape[0]))
+        permuted = permute_csr(indices, self._row_ids,
+                               key if key is not None else self.next_key())
+        rows = as_index_rows(permuted)
+        if self.mode == "HOST":
+            # keep the shuffled topology host-resident (the mode exists
+            # because indices don't fit HBM); the sampler's row fetches
+            # then stream from host like the exact path's
+            try:
+                sh = jax.sharding.SingleDeviceSharding(
+                    list(rows.devices())[0], memory_kind="pinned_host")
+                rows = jax.device_put(rows, sh)
+            except (ValueError, NotImplementedError):
+                pass
+        self._rot = rows
+
     # -- core ---------------------------------------------------------------
     def _build_fn(self, batch_size: int):
         sizes = self.sizes
         weighted = self.edge_weight is not None
+        method = self.sampling
 
-        def run(indptr, indices, seeds, key, weights=None):
+        def run(indptr, indices, seeds, key, weights=None, rows=None):
             from ..ops.sample_multihop import sample_multihop
             return sample_multihop(indptr, indices, seeds, sizes, key,
-                                   edge_weight=weights if weighted else None)
+                                   edge_weight=weights if weighted else None,
+                                   method=method, indices_rows=rows)
 
         return jax.jit(run)
 
@@ -171,8 +214,14 @@ class GraphSageSampler:
         fn = self._fn_for(bs)
         if self.edge_weight is not None and self._weight_placed is None:
             self._weight_placed = jnp.asarray(self.edge_weight)
+        if self.sampling == "rotation":
+            if self._rot is None:
+                self.reshuffle()
+            rows = self._rot
+        else:
+            rows = None
         n_id, layers = fn(jnp.asarray(indptr), jnp.asarray(indices),
-                          seeds, self.next_key(), self._weight_placed)
+                          seeds, self.next_key(), self._weight_placed, rows)
         shapes = layer_shapes(bs, self.sizes)
         adjs = []
         for layer, shape in zip(layers, shapes):
@@ -223,13 +272,13 @@ class GraphSageSampler:
     # -- process sharing (API compat; jax is single-process-per-host) -------
     def share_ipc(self):
         return (self.csr_topo, self.device, self.mode, self.sizes,
-                self.edge_weight)
+                self.edge_weight, self.sampling)
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
-        csr_topo, device, mode, sizes, edge_weight = ipc_handle
+        csr_topo, device, mode, sizes, edge_weight, sampling = ipc_handle
         return cls(csr_topo, sizes, device=device, mode=mode,
-                   edge_weight=edge_weight)
+                   edge_weight=edge_weight, sampling=sampling)
 
 
 class SampleJob(Generic[T_co]):

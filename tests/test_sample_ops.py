@@ -273,3 +273,51 @@ def _prob_oracle(indptr, indices, train, sizes, n):
             cur[v] = 1 - (1 - last[v]) * acc
         last = cur
     return last
+
+
+class TestRandomWalk:
+    def test_steps_are_neighbors(self, small_graph):
+        from quiver_tpu.ops import random_walk
+        indptr, indices = small_graph
+        nsets = neighbor_sets(indptr, indices)
+        starts = np.array([v for v in range(len(indptr) - 1)
+                           if indptr[v + 1] > indptr[v]], dtype=np.int32)
+        paths = np.asarray(random_walk(
+            jnp.asarray(indptr), jnp.asarray(indices), jnp.asarray(starts),
+            3, KEY))
+        assert paths.shape == (len(starts), 4)
+        np.testing.assert_array_equal(paths[:, 0], starts)
+        for r, s0 in enumerate(starts):
+            for t in range(3):
+                a, b = paths[r, t], paths[r, t + 1]
+                deg = indptr[a + 1] - indptr[a]
+                if deg == 0:
+                    assert b == a       # stuck walkers stay
+                else:
+                    assert b in nsets[a]
+
+    def test_zero_degree_stays(self):
+        from quiver_tpu.ops import random_walk
+        indptr = np.array([0, 0, 1])
+        indices = np.array([0])
+        paths = np.asarray(random_walk(
+            jnp.asarray(indptr), jnp.asarray(indices),
+            jnp.array([0, 1], jnp.int32), 2, KEY))
+        assert paths[0].tolist() == [0, 0, 0]       # deg 0: stays
+        assert paths[1].tolist() == [1, 0, 0]       # 1 -> 0 (only edge)
+
+
+class TestSampleMultihopDedup:
+    def test_duplicate_batch_collapses(self, small_graph):
+        from quiver_tpu.ops import sample_multihop_dedup
+        indptr, indices = small_graph
+        batch = jnp.array([3, 7, 3, 9, 7, 3], jnp.int32)
+        n_id, layers, blocals = sample_multihop_dedup(
+            jnp.asarray(indptr), jnp.asarray(indices), batch, [3], KEY)
+        n_id = np.asarray(n_id)
+        blocals = np.asarray(blocals)
+        valid = n_id[n_id >= 0]
+        assert len(np.unique(valid)) == len(valid)
+        # every batch entry maps to its own id's slot
+        for i, g in enumerate([3, 7, 3, 9, 7, 3]):
+            assert n_id[blocals[i]] == g

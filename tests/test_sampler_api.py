@@ -79,6 +79,20 @@ class TestGraphSageSamplerHBM:
         n_id, bs, adjs = s2.sample(seeds)
         check_sample_output(topo, seeds, n_id, bs, adjs, [4, 2])
 
+    def test_rotation_sampling_end_to_end(self, topo, rng):
+        sampler = qv.GraphSageSampler(topo, sizes=[5, 3], mode="HBM",
+                                      sampling="rotation")
+        seeds = rng.choice(topo.node_count, 32, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_sample_output(topo, seeds, n_id, bs, adjs, [5, 3])
+        sampler.reshuffle()          # epoch boundary
+        n_id2, _, adjs2 = sampler.sample(seeds)
+        check_sample_output(topo, seeds, n_id2, bs, adjs2, [5, 3])
+
+    def test_rotation_rejects_large_fanout(self, topo):
+        with pytest.raises(ValueError):
+            qv.GraphSageSampler(topo, [200], sampling="rotation")
+
 
 class TestNativeCPUEngine:
     def test_native_lib_builds(self):
