@@ -9,7 +9,11 @@ Usage: python benchmarks/bench_feature.py [--rows N] [--dim D]
 """
 
 import argparse
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

@@ -1,0 +1,114 @@
+"""Multi-host partitioned features: partition -> dispatch -> all_to_all.
+
+Demonstrates the DistFeature scaling story (reference multi-node path:
+PartitionInfo/DistFeature + NcclComm exchange, feature.py:461-567 +
+comm.py:127-182) on a virtual 8-host mesh — the same program runs
+unchanged on a real multi-host TPU pod where the mesh axis rides ICI/DCN.
+
+Every "host" holds a shard of the feature rows (probability-partitioned);
+each host requests the rows its sampled frontier needs; one jitted
+all_to_all pair ships requests and responses. Verified against the
+unpartitioned ground truth.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python examples/dist_feature_demo.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from quiver_tpu import CSRTopo, PartitionInfo, TpuComm
+    from quiver_tpu.ops import sample_multihop, sample_prob
+    from quiver_tpu.partition import partition_feature_without_replication
+
+    devs = jax.devices()
+    hosts = len(devs)
+    mesh = Mesh(np.array(devs), axis_names=("host",))
+    print(f"mesh: {hosts} hosts ({devs[0].platform})")
+
+    # ---- graph + features --------------------------------------------------
+    rng = np.random.default_rng(0)
+    n, dim = 20000, 64
+    deg = rng.integers(2, 20, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]))
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+
+    # ---- probability-driven partition (reference partition.py:14-70) -------
+    train_idx = rng.choice(n, n // 10, replace=False)
+    probs = sample_prob(jnp.asarray(topo.indptr), jnp.asarray(topo.indices),
+                        jnp.asarray(train_idx), [15, 10], n)
+    parts, _ = partition_feature_without_replication(
+        [np.asarray(probs)] * hosts, chunk_size=256)
+    global2host = np.zeros(n, np.int32)
+    for h, part in enumerate(parts):
+        global2host[np.asarray(part)] = h
+    info = [PartitionInfo(host=h, hosts=hosts, global2host=global2host)
+            for h in range(hosts)]
+
+    # ---- per-host local stores, row-sharded over the mesh ------------------
+    rows_per_host = max(info[0].local_sizes)
+    store = np.zeros((hosts, rows_per_host, dim), np.float32)
+    g2l = np.asarray(info[0].global2local)
+    for g in range(n):
+        store[global2host[g], g2l[g]] = feat[g]
+    feat_sharded = jax.device_put(
+        store.reshape(hosts * rows_per_host, dim),
+        NamedSharding(mesh, P("host")))
+
+    # ---- each host samples a frontier and requests its rows ----------------
+    comm = TpuComm(rank=0, world_size=hosts, mesh=mesh, axis="host")
+    cap = 4096
+    key = jax.random.key(0)
+    req = np.full((hosts, hosts, cap), -1, np.int32)
+    wanted = []                       # per host: (global ids, owner, pos)
+    for h in range(hosts):
+        seeds = jnp.asarray(rng.choice(n, 256, replace=False), jnp.int32)
+        n_id, _ = sample_multihop(jnp.asarray(topo.indptr),
+                                  jnp.asarray(topo.indices), seeds, [10, 5],
+                                  jax.random.fold_in(key, h))
+        ids = np.asarray(n_id)
+        ids = ids[ids >= 0]
+        host_ids, host_pos = info[h].dispatch(ids)
+        for d in range(hosts):
+            take = min(host_ids[d].size, cap)
+            req[h, d, :take] = host_ids[d][:take]
+        wanted.append((ids, host_ids, host_pos))
+
+    # warmup (compile), then timed run
+    jax.block_until_ready(
+        comm.exchange_spmd(jnp.asarray(req), feat_sharded, cap))
+    t0 = time.time()
+    resp = comm.exchange_spmd(jnp.asarray(req), feat_sharded, cap)
+    resp = np.asarray(jax.block_until_ready(resp))
+    dt = time.time() - t0
+
+    # ---- verify against ground truth --------------------------------------
+    checked = 0
+    for h in range(hosts):
+        ids, host_ids, host_pos = wanted[h]
+        for d in range(hosts):
+            take = min(host_ids[d].size, cap)
+            got = resp[h, d, :take]
+            want = feat[ids[host_pos[d][:take]]]
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+            checked += take
+    total_bytes = checked * dim * 4
+    print(f"exchanged {checked} rows across {hosts} hosts in {dt * 1e3:.1f} ms"
+          f" ({total_bytes / dt / 1e9:.2f} GB/s) — all verified")
+
+
+if __name__ == "__main__":
+    main()

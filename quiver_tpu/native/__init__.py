@@ -50,20 +50,87 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        path = _LIB_PATH if os.path.exists(_LIB_PATH) else _build()
+        stale = (not os.path.exists(_LIB_PATH)
+                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+        path = _build() if stale else _LIB_PATH
         if path is None:
             _build_failed = True
             return None
-        lib = ctypes.CDLL(path)
-        lib.qt_sample_layer.argtypes = [
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
-            ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
-        ]
-        lib.qt_sample_layer.restype = None
+        try:
+            lib = _bind(ctypes.CDLL(path))
+        except (OSError, AttributeError):
+            # cached .so predates a symbol we now need -> rebuild once
+            path = _build()
+            if path is None:
+                _build_failed = True
+                return None
+            try:
+                lib = _bind(ctypes.CDLL(path))
+            except (OSError, AttributeError):
+                _build_failed = True
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.qt_sample_layer.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.qt_sample_layer.restype = None
+    lib.qt_reindex.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.qt_reindex.restype = ctypes.c_int64
+    return lib
+
+
+def cpu_reindex(seeds: np.ndarray, nbrs: np.ndarray
+                ) -> Tuple[np.ndarray, int, np.ndarray, np.ndarray]:
+    """First-occurrence hop compaction on the host (C++ hash table, numpy
+    fallback). seeds [s] (-1 ok), nbrs [s, k] (-1 fill).
+    Returns (n_id [s + s*k] -1-filled, count, row [s*k], col [s*k])."""
+    seeds = np.ascontiguousarray(seeds, dtype=np.int32)
+    nbrs = np.ascontiguousarray(nbrs, dtype=np.int32)
+    s, k = nbrs.shape
+    cap = s + s * k
+    n_id = np.empty((cap,), np.int32)
+    row = np.empty((s * k,), np.int32)
+    col = np.empty((s * k,), np.int32)
+    lib = get_lib()
+    if lib is not None:
+        count = lib.qt_reindex(
+            _ptr(seeds, ctypes.c_int32), s, _ptr(nbrs, ctypes.c_int32), k,
+            _ptr(n_id, ctypes.c_int32), _ptr(row, ctypes.c_int32),
+            _ptr(col, ctypes.c_int32))
+        return n_id, int(count), row, col
+    # numpy fallback: vectorized first-occurrence unique (stable argsort
+    # of first-occurrence positions), same contract as the C++ path
+    flat = np.concatenate([seeds, nbrs.reshape(-1)])
+    valid = flat >= 0
+    vals, first_idx = np.unique(flat[valid], return_index=True)
+    order = np.argsort(np.flatnonzero(valid)[first_idx], kind="stable")
+    uniq = vals[order]                       # first-occurrence order
+    count = int(uniq.shape[0])
+    rank_to_local = np.empty_like(order, dtype=np.int32)
+    rank_to_local[order] = np.arange(count, dtype=np.int32)
+    n_id[:] = -1
+    n_id[:count] = uniq
+    safe = np.where(valid, flat, vals[0] if count else 0)
+    local_all = rank_to_local[np.searchsorted(vals, safe)] if count else \
+        np.zeros_like(flat)
+    seed_local = np.where(seeds >= 0, local_all[:s], -1)
+    nbr_flat = nbrs.reshape(-1)
+    edge_ok = (nbr_flat >= 0) & np.repeat(seed_local >= 0, k)
+    row[:] = np.where(edge_ok, np.repeat(seed_local, k), -1)
+    col[:] = np.where(edge_ok, local_all[s:], -1)
+    return n_id, count, row, col
 
 
 def _ptr(arr, ctype):
@@ -109,18 +176,6 @@ def _numpy_sample_layer(indptr, indices, seeds, k, seed):
     return nbrs, counts
 
 
-def first_occurrence_unique(flat: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Unique values of ``flat`` (ignoring <0) in first-occurrence order,
-    plus a (sorted_vals, rank->local) pair for id translation."""
-    valid_pos = np.flatnonzero(flat >= 0)
-    vals, first_idx = np.unique(flat[valid_pos], return_index=True)
-    order = np.argsort(valid_pos[first_idx], kind="stable")
-    uniq = vals[order]
-    rank_to_local = np.empty(len(vals), dtype=np.int32)
-    rank_to_local[order] = np.arange(len(vals), dtype=np.int32)
-    return uniq, (vals, rank_to_local)
-
-
 def cpu_sample_multihop(indptr, indices, seeds: np.ndarray,
                         sizes: Sequence[int], seed: int = 0,
                         num_threads: int = 0
@@ -133,26 +188,10 @@ def cpu_sample_multihop(indptr, indices, seeds: np.ndarray,
     cur = np.ascontiguousarray(seeds, dtype=np.int32)
     rows, cols = [], []
     for li, k in enumerate(sizes):
-        s = cur.shape[0]
         nbrs, _counts = cpu_sample_layer(
             indptr, indices, cur, k, seed=seed + li, num_threads=num_threads)
-        flat = np.concatenate([cur, nbrs.reshape(-1)])
-        uniq, (sorted_vals, rank_to_local) = first_occurrence_unique(flat)
-
-        nbr_flat = nbrs.reshape(-1)
-        valid = nbr_flat >= 0
-        col = np.full(s * k, -1, dtype=np.int32)
-        safe = np.where(valid, nbr_flat, sorted_vals[0] if len(sorted_vals)
-                        else 0)
-        if len(sorted_vals):
-            col_vals = rank_to_local[np.searchsorted(sorted_vals, safe)]
-            col[valid] = col_vals[valid]
-        row = np.where(valid, np.repeat(np.arange(s, dtype=np.int32), k), -1)
+        n_id, _count, row, col = cpu_reindex(cur, nbrs)
         rows.append(row)
         cols.append(col)
-
-        cap = s + s * k
-        nxt = np.full(cap, -1, dtype=np.int32)
-        nxt[:len(uniq)] = uniq
-        cur = nxt
+        cur = n_id
     return cur, rows, cols

@@ -108,3 +108,63 @@ void qt_degree(const int64_t *indptr, const int32_t *seeds, int64_t num_seeds,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// First-occurrence reindex of one sampled hop — the host-side counterpart
+// of the device layer compaction (reference CPU path: CPUQuiver's
+// unordered_map reindex, srcs/cpp/src/quiver/quiver.cpp:11-119). Open
+// addressing instead of std::unordered_map: one flat probe array, no
+// per-node allocations.
+//
+// seeds [s] (-1 fill allowed), nbrs [s*k] (-1 fill).
+// out_n_id [s + s*k]: unique ids, first-occurrence order (valid seeds
+// first, packed), -1 fill. out_row/out_col [s*k]: local-id COO (-1 fill).
+// Returns the number of valid unique ids.
+int64_t qt_reindex(const int32_t *seeds, int64_t s, const int32_t *nbrs,
+                   int32_t k, int32_t *out_n_id, int32_t *out_row,
+                   int32_t *out_col) {
+    const int64_t cap = s + s * (int64_t)k;
+    uint64_t table_size = 16;
+    while (table_size < (uint64_t)(2 * cap)) table_size <<= 1;
+    std::vector<int32_t> keys(table_size, -1);
+    std::vector<int32_t> vals(table_size, -1);
+    const uint64_t mask = table_size - 1;
+
+    int64_t count = 0;
+    auto lookup_or_insert = [&](int32_t id) -> int32_t {
+        uint64_t h = (uint64_t)(uint32_t)id * 0x9E3779B97F4A7C15ULL;
+        uint64_t slot = (h >> 17) & mask;
+        for (;;) {
+            if (keys[slot] == id) return vals[slot];
+            if (keys[slot] == -1) {
+                keys[slot] = id;
+                vals[slot] = (int32_t)count;
+                out_n_id[count++] = id;
+                return vals[slot];
+            }
+            slot = (slot + 1) & mask;
+        }
+    };
+
+    std::vector<int32_t> seed_local(s);
+    for (int64_t i = 0; i < s; ++i)
+        seed_local[i] = seeds[i] < 0 ? -1 : lookup_or_insert(seeds[i]);
+    for (int64_t i = 0; i < s; ++i) {
+        for (int32_t t = 0; t < k; ++t) {
+            const int64_t e = i * k + t;
+            const int32_t nb = nbrs[e];
+            if (nb < 0 || seed_local[i] < 0) {
+                out_row[e] = -1;
+                out_col[e] = -1;
+            } else {
+                out_row[e] = seed_local[i];
+                out_col[e] = lookup_or_insert(nb);
+            }
+        }
+    }
+    std::fill(out_n_id + count, out_n_id + cap, -1);
+    return count;
+}
+
+}  // extern "C"

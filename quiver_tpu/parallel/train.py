@@ -64,8 +64,10 @@ def masked_feature_gather(feat: jax.Array, n_id: jax.Array,
 
 
 def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
-                indptr, indices, seeds, labels, key):
-    n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key)
+                indptr, indices, seeds, labels, key, method="exact",
+                indices_rows=None):
+    n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
+                                   method=method, indices_rows=indices_rows)
     x = masked_feature_gather(feat, n_id, forder)
     adjs = layers_to_adjs(layers, batch_size, sizes)
     logits = model.apply(params, x, adjs, train=True,
@@ -74,17 +76,22 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
 
 
 def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
-                     loss_fn: Callable = cross_entropy_logits):
+                     loss_fn: Callable = cross_entropy_logits,
+                     method: str = "exact"):
     """Single-chip fused step:
-    fn(state, feat, forder, indptr, indices, seeds, labels, key)."""
+    fn(state, feat, forder, indptr, indices, seeds, labels, key[,
+    indices_rows]). With ``method="rotation"`` pass the shuffled
+    ``as_index_rows`` view as ``indices_rows`` (refresh per epoch with
+    ``permute_csr``)."""
     sizes = list(sizes)
 
     @jax.jit
     def step(state: TrainState, feat, forder, indptr, indices, seeds,
-             labels, key):
+             labels, key, indices_rows=None):
         loss, grads = jax.value_and_grad(
             lambda p: _fused_loss(model, loss_fn, sizes, batch_size, p, feat,
-                                  forder, indptr, indices, seeds, labels, key)
+                                  forder, indptr, indices, seeds, labels, key,
+                                  method, indices_rows)
         )(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -96,20 +103,22 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
 def build_e2e_train_step(model, tx, sizes: Sequence[int],
                          per_device_batch: int, mesh: Mesh,
                          axis: str = "data",
-                         loss_fn: Callable = cross_entropy_logits):
+                         loss_fn: Callable = cross_entropy_logits,
+                         method: str = "exact"):
     """Data-parallel fused step over ``mesh[axis]``:
-    fn(state, feat, forder, indptr, indices, seeds, labels, key) with
-    seeds/labels [n_dev * per_device_batch] sharded over ``axis``;
-    state/feat/topology replicated; grads pmean over ``axis``."""
+    fn(state, feat, forder, indptr, indices, seeds, labels, key[,
+    indices_rows]) with seeds/labels [n_dev * per_device_batch] sharded
+    over ``axis``; state/feat/topology (and the shuffled rows view when
+    ``method="rotation"``) replicated; grads pmean over ``axis``."""
     sizes = list(sizes)
 
     def per_shard(state: TrainState, feat, forder, indptr, indices, seeds,
-                  labels, key):
+                  labels, key, indices_rows=None):
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         loss, grads = jax.value_and_grad(
             lambda p: _fused_loss(model, loss_fn, sizes, per_device_batch, p,
                                   feat, forder, indptr, indices, seeds,
-                                  labels, key)
+                                  labels, key, method, indices_rows)
         )(state.params)
         grads = jax.lax.pmean(grads, axis)
         loss = jax.lax.pmean(loss, axis)
@@ -117,9 +126,12 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
+    specs = [P(), P(), P(), P(), P(), P(axis), P(axis), P()]
+    if method == "rotation":
+        specs.append(P())   # indices_rows, replicated
     mapped = shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), P(axis), P(axis), P()),
+        in_specs=tuple(specs),
         out_specs=(P(), P()),
         check_vma=False)
     return jax.jit(mapped)

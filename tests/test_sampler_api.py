@@ -179,3 +179,48 @@ class TestMixedSampler:
             np.array([0, 1, 2]), topo.node_count))
         assert prob.shape == (topo.node_count,)
         assert (prob >= 0).all() and (prob <= 1).all()
+
+
+class TestNativeReindex:
+    def test_matches_contract(self, rng):
+        from quiver_tpu.native import cpu_reindex, get_lib
+        s, k = 50, 6
+        seeds = rng.choice(2000, s, replace=False).astype(np.int32)
+        seeds[45:] = -1                      # -1 tail allowed
+        nbrs = rng.integers(0, 2000, (s, k)).astype(np.int32)
+        nbrs[rng.random((s, k)) < 0.25] = -1
+        nbrs[45:] = -1                       # invalid seeds have no edges
+        n_id, count, row, col = cpu_reindex(seeds, nbrs)
+        valid = n_id[:count]
+        assert len(np.unique(valid)) == count
+        # valid seeds occupy the first slots in order
+        np.testing.assert_array_equal(valid[:45], seeds[:45])
+        local = {g: i for i, g in enumerate(valid.tolist())}
+        for i in range(s):
+            for t in range(k):
+                e = i * k + t
+                if nbrs[i, t] < 0 or seeds[i] < 0:
+                    assert row[e] == -1 and col[e] == -1
+                else:
+                    assert row[e] == local[seeds[i]]
+                    assert col[e] == local[nbrs[i, t]]
+        assert (n_id[count:] == -1).all()
+
+    def test_cpp_and_numpy_agree(self, rng):
+        import quiver_tpu.native as nat
+        if nat.get_lib() is None:
+            pytest.skip("no compiler")
+        s, k = 30, 4
+        seeds = rng.choice(500, s, replace=False).astype(np.int32)
+        nbrs = rng.integers(0, 500, (s, k)).astype(np.int32)
+        got = nat.cpu_reindex(seeds, nbrs)
+        lib, nat._lib = nat._lib, None            # force numpy fallback
+        nat._build_failed = True
+        try:
+            want = nat.cpu_reindex(seeds, nbrs)
+        finally:
+            nat._lib, nat._build_failed = lib, False
+        np.testing.assert_array_equal(got[0], want[0])
+        assert got[1] == want[1]
+        np.testing.assert_array_equal(got[2], want[2])
+        np.testing.assert_array_equal(got[3], want[3])
