@@ -48,6 +48,10 @@ def main():
         masked_feature_gather)
 
     n, bs, sizes = args.nodes, args.batch, [15, 10, 5]
+    if args.batches * bs > n:
+        args.batches = max(1, n // bs)
+        print(f"note: clamping --batches to {args.batches} "
+              f"(only {n} nodes for {bs}-seed batches)")
     key = jax.random.key(0)
 
     @jax.jit

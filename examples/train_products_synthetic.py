@@ -46,6 +46,10 @@ def main():
     p.add_argument("--sizes", type=int, nargs="+", default=[15, 10, 5])
     p.add_argument("--cache", default="1GB",
                    help="device cache budget for the feature store")
+    p.add_argument("--cache-policy", default="device_replicate",
+                   choices=["device_replicate", "p2p_clique_replicate"],
+                   help="p2p_clique_replicate row-shards the hot set over "
+                        "all devices (the papers100M-scale layout)")
     p.add_argument("--data-parallel", action="store_true",
                    help="shard the batch over all local devices")
     p.add_argument("--npz", default=None)
@@ -59,8 +63,8 @@ def main():
     from quiver_tpu.ops import sample_multihop
     from quiver_tpu.parallel import make_mesh
     from quiver_tpu.parallel.train import (
-        build_e2e_train_step, build_train_step, init_state, layers_to_adjs,
-        masked_feature_gather)
+        build_e2e_train_step, build_split_train_step, build_train_step,
+        init_state, layers_to_adjs, masked_feature_gather)
 
     if args.npz:
         data = np.load(args.npz)
@@ -74,8 +78,12 @@ def main():
             args.nodes, args.avg_deg, args.dim, args.classes)
         topo = qv.CSRTopo(indptr=indptr, indices=indices)
 
+    mesh_for_cache = None
+    if args.cache_policy == "p2p_clique_replicate":
+        mesh_for_cache = make_mesh(("cache",))
     # tiered feature store: hottest rows in HBM (degree-ordered), rest host
-    feature = qv.Feature(device_cache_size=args.cache, csr_topo=topo)
+    feature = qv.Feature(device_cache_size=args.cache, csr_topo=topo,
+                         cache_policy=args.cache_policy, mesh=mesh_for_cache)
     feature.from_cpu_tensor(feat_np)
     print(f"feature store: {feature.cache_rows}/{feat_np.shape[0]} rows "
           f"cached in HBM")
@@ -92,20 +100,30 @@ def main():
 
     indptr_j = jnp.asarray(topo.indptr)
     indices_j = jnp.asarray(topo.indices)
-    # training path gathers from the fused HBM view when fully cached,
-    # else through the tiered store
-    fully_cached = feature.host_part is None
-    feat_j = feature.device_part if fully_cached else jnp.asarray(feat_np)
+    # fully cached (+ single-device replica): fuse the gather into the
+    # train step; otherwise sample on device and fetch each batch's rows
+    # through the tiered store (host tier included) like the reference
+    fully_cached = (feature.host_part is None
+                    and args.cache_policy == "device_replicate")
+    feat_j = feature.device_part if fully_cached else None
     forder = feature.feature_order if fully_cached else None
 
     seeds0 = jnp.asarray(train_idx[:per_dev].astype(np.int32))
     n_id, layers = sample_multihop(indptr_j, indices_j, seeds0, sizes,
                                    jax.random.key(0))
     adjs = layers_to_adjs(layers, per_dev, sizes)
-    x = masked_feature_gather(feat_j, n_id, forder)
+    x = masked_feature_gather(feat_j, n_id, forder) if fully_cached \
+        else jnp.asarray(feature[n_id])
     state = init_state(model, tx, x, adjs, jax.random.key(1))
 
-    if mesh:
+    sample_fn = apply_fn = None
+    if not fully_cached:
+        if mesh:
+            print("NOTE: --data-parallel applies to the fused fully-cached "
+                  "path; the tiered-store path runs single-program "
+                  "(full batch)")
+        sample_fn, apply_fn = build_split_train_step(model, tx, sizes, bs)
+    elif mesh:
         step = build_e2e_train_step(model, tx, sizes, per_dev, mesh)
     else:
         step = build_train_step(model, tx, sizes, per_dev)
@@ -119,8 +137,15 @@ def main():
         for lo in range(0, len(perm) - bs + 1, bs):
             seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
             y = jnp.asarray(labels[perm[lo:lo + bs]])
-            state, loss = step(state, feat_j, forder, indptr_j, indices_j,
-                               seeds, y, jax.random.key(it))
+            if fully_cached:
+                state, loss = step(state, feat_j, forder, indptr_j,
+                                   indices_j, seeds, y, jax.random.key(it))
+            else:
+                n_id, adjs = sample_fn(indptr_j, indices_j, seeds,
+                                       jax.random.key(it))
+                x = feature[n_id]          # tiered gather (HBM + host)
+                state, loss = apply_fn(state, x, adjs, y,
+                                       jax.random.key(1000000 + it))
             it += 1
             epoch_loss += float(loss)
             nb += 1

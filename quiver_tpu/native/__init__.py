@@ -50,9 +50,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        stale = (not os.path.exists(_LIB_PATH)
-                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+        have_so = os.path.exists(_LIB_PATH)
+        stale = (not have_so
+                 or (os.path.exists(_SRC)
+                     and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)))
         path = _build() if stale else _LIB_PATH
+        if path is None and have_so:
+            path = _LIB_PATH        # no compiler: try the prebuilt .so
         if path is None:
             _build_failed = True
             return None
@@ -112,7 +116,10 @@ def cpu_reindex(seeds: np.ndarray, nbrs: np.ndarray
         return n_id, int(count), row, col
     # numpy fallback: vectorized first-occurrence unique (stable argsort
     # of first-occurrence positions), same contract as the C++ path
-    flat = np.concatenate([seeds, nbrs.reshape(-1)])
+    # neighbors of invalid (-1) seeds carry no edges and must not enter
+    # the unique set (matches the C++ path)
+    nbr_masked = np.where(np.repeat(seeds >= 0, k), nbrs.reshape(-1), -1)
+    flat = np.concatenate([seeds, nbr_masked])
     valid = flat >= 0
     vals, first_idx = np.unique(flat[valid], return_index=True)
     order = np.argsort(np.flatnonzero(valid)[first_idx], kind="stable")
@@ -126,7 +133,7 @@ def cpu_reindex(seeds: np.ndarray, nbrs: np.ndarray
     local_all = rank_to_local[np.searchsorted(vals, safe)] if count else \
         np.zeros_like(flat)
     seed_local = np.where(seeds >= 0, local_all[:s], -1)
-    nbr_flat = nbrs.reshape(-1)
+    nbr_flat = nbr_masked
     edge_ok = (nbr_flat >= 0) & np.repeat(seed_local >= 0, k)
     row[:] = np.where(edge_ok, np.repeat(seed_local, k), -1)
     col[:] = np.where(edge_ok, local_all[s:], -1)

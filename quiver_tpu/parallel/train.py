@@ -137,6 +137,44 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     return jax.jit(mapped)
 
 
+def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
+                           loss_fn: Callable = cross_entropy_logits,
+                           method: str = "exact"):
+    """Two-phase step for tiered feature stores (the reference's own
+    architecture: sampling and feature collection run as separate stages
+    around the model, examples/pyg/reddit_quiver.py:116-122):
+
+      sample_fn(indptr, indices, seeds, key[, indices_rows]) -> (n_id, adjs)
+      step_fn(state, x, adjs, labels, key) -> (state, loss)
+
+    Use when features live partly on host/disk: sample on device, fetch
+    ``x = feature[n_id]`` through the tiered store, then run the fused
+    forward/backward/update.
+    """
+    sizes = list(sizes)
+
+    @jax.jit
+    def sample_fn(indptr, indices, seeds, key, indices_rows=None):
+        n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
+                                       method=method,
+                                       indices_rows=indices_rows)
+        return n_id, layers_to_adjs(layers, batch_size, sizes)
+
+    @jax.jit
+    def step_fn(state: TrainState, x, adjs, labels, key):
+        def loss_of(p):
+            logits = model.apply(p, x, adjs, train=True,
+                                 rngs={"dropout": key})
+            return loss_fn(logits[:batch_size], labels)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return sample_fn, step_fn
+
+
 def init_state(model, tx, example_x, example_adjs, key) -> TrainState:
     params = model.init(key, example_x, example_adjs)
     return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))

@@ -109,7 +109,7 @@ class TestSingleChipTraining:
         n = feat.shape[0]
         first_loss = last_loss = None
         for it in range(60):
-            seeds = rng.integers(0, n, bs).astype(np.int32)
+            seeds = rng.choice(n, bs, replace=False).astype(np.int32)
             y = jnp.asarray(labels[seeds])
             state, loss = step(state, feat, None, indptr, indices,
                                jnp.asarray(seeds), y, jax.random.key(it))
@@ -134,6 +134,32 @@ class TestSingleChipTraining:
                       jnp.asarray(new_order, jnp.int32),
                       indptr, indices, seeds, y, k)
         assert abs(float(l1) - float(l2)) < 1e-5
+
+
+class TestRotationTraining:
+    def test_rotation_step_learns(self, planted):
+        from quiver_tpu.ops import as_index_rows, edge_row_ids, permute_csr
+        sizes, bs = [5, 3], 32
+        topo, model, tx, state, feat, labels = _setup(planted, sizes, bs)
+        step = build_train_step(model, tx, sizes, bs, method="rotation")
+        indptr, indices = jnp.asarray(topo.indptr), jnp.asarray(topo.indices)
+        row_ids = edge_row_ids(indptr, int(indices.shape[0]))
+        rng = np.random.default_rng(0)
+        n = feat.shape[0]
+        first_loss = last_loss = None
+        for it in range(60):
+            if it % 20 == 0:   # epoch boundary: reshuffle rows
+                permuted = permute_csr(indices, row_ids, jax.random.key(it))
+                rows = as_index_rows(permuted)
+            seeds = rng.choice(n, bs, replace=False).astype(np.int32)
+            y = jnp.asarray(labels[seeds])
+            state, loss = step(state, feat, None, indptr, permuted,
+                               jnp.asarray(seeds), y, jax.random.key(it),
+                               rows)
+            if first_loss is None:
+                first_loss = float(loss)
+            last_loss = float(loss)
+        assert last_loss < first_loss * 0.7, (first_loss, last_loss)
 
 
 class TestDataParallelTraining:
