@@ -12,25 +12,129 @@ products-like scale) — no multi-hundred-MB host->device transfer, which
 matters when the chip sits behind a slow tunnel.
 
 Scale knobs (env): QT_BENCH_NODES, QT_BENCH_AVG_DEG, QT_BENCH_BATCHES,
-QT_BENCH_BATCH, QT_BENCH_TIME_BUDGET (secs, soft cap on the timed loop).
+QT_BENCH_BATCH.
+
+Robustness: the TPU backend sits behind a tunnel that can hang
+indefinitely at init (not just error). Before touching the backend in
+this process, a subprocess probe with a hard timeout checks it is alive;
+if not, ONE JSON line with an "error" field is printed and the process
+exits 1 within ~2 minutes instead of hanging forever. The guarantee
+covers init-time failure only — a tunnel that drops mid-run can still
+hang the timed region. (The probe costs one extra backend init on
+healthy runs — accepted: the bench runs once per round and a hang costs
+the whole round.)
+
+CPU smoke mode: QT_BENCH_PLATFORM=cpu (or --platform cpu) pins the CPU
+backend at a reduced scale so the harness can be sanity-run with no TPU.
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 BASELINE_SEPS = 34.29e6   # reference Quiver UVA, 1 GPU, products [15,10,5]
 
+PROBE_SNIPPET = (
+    "import jax, sys; d = jax.devices(); "
+    "print(d[0].platform); sys.stdout.flush()"
+)
+
+
+def _error_line(stderr):
+    """Pick the line naming the actual error, not jax's traceback footer
+    ('For simplicity, JAX has removed its internal frames...')."""
+    lines = [l for l in stderr.splitlines() if l.strip()]
+    for l in reversed(lines):
+        if "Error" in l or "UNAVAILABLE" in l:
+            return l.strip()
+    return lines[-1].strip() if lines else "unknown error"
+
+
+def probe_backend(platform="", timeout_s=55.0, retries=2):
+    """Check the jax backend initializes, out-of-process.
+
+    The axon/TPU init can hang (uninterruptibly) rather than raise, so the
+    probe MUST run in a subprocess we can kill — and the post-kill reap is
+    itself bounded, in case the child is stuck in an unkillable D-state.
+    Returns (ok, detail).
+    """
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    detail = ""
+    for attempt in range(retries):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", PROBE_SNIPPET], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable child; abandon it rather than hang
+            detail = (f"backend init timed out after {timeout_s:.0f}s "
+                      f"(attempt {attempt + 1}/{retries})")
+            continue
+        if proc.returncode == 0:
+            return True, stdout.strip()
+        detail = _error_line(stderr)
+    return False, detail
+
 
 def main():
-    n_nodes = int(os.environ.get("QT_BENCH_NODES", 2_450_000))
-    avg_deg = int(os.environ.get("QT_BENCH_AVG_DEG", 25))
+    platform = os.environ.get("QT_BENCH_PLATFORM", "")
+    if "--platform" in sys.argv:
+        i = sys.argv.index("--platform") + 1
+        if i >= len(sys.argv):
+            print(json.dumps({"error": "--platform requires a value"}))
+            sys.exit(2)
+        platform = sys.argv[i]
+    # importing jax is safe — only backend *init* can hang
+    import jax
+    explicit = bool(platform)
+    if not platform:
+        platform = jax.config.jax_platforms or ""
+    cpu_smoke = platform == "cpu"
+
+    if cpu_smoke:
+        # reduced scale: this mode exists to prove the harness runs, not
+        # to produce a comparable number
+        defaults = dict(nodes=200_000, deg=10, batches=8)
+    else:
+        ok, detail = probe_backend(platform if explicit else "")
+        if not ok or detail == "cpu":
+            # a probe that lands on CPU means the TPU plugin silently
+            # fell back — a full-scale CPU run would masquerade as a TPU
+            # number, so refuse (use --platform cpu for an honest smoke)
+            err = (f"TPU backend unavailable: {detail}" if not ok else
+                   "backend probe resolved to CPU, not TPU; refusing the "
+                   "full-scale bench (use --platform cpu for smoke mode)")
+            print(json.dumps({
+                "metric": "sampled-edges/sec (ogbn-products-scale, "
+                          "fanout [15,10,5], batch 1024)",
+                "value": None,
+                "unit": "edges/s",
+                "vs_baseline": None,
+                "error": err,
+            }))
+            sys.exit(1)
+        defaults = dict(nodes=2_450_000, deg=25, batches=192)
+
+    n_nodes = int(os.environ.get("QT_BENCH_NODES", defaults["nodes"]))
+    avg_deg = int(os.environ.get("QT_BENCH_AVG_DEG", defaults["deg"]))
     # one epoch of ogbn-products train split (196k seeds / batch 1024)
-    batches = int(os.environ.get("QT_BENCH_BATCHES", 192))
+    batches = int(os.environ.get("QT_BENCH_BATCHES", defaults["batches"]))
     batch = int(os.environ.get("QT_BENCH_BATCH", 1024))
     sizes = [15, 10, 5]
 
-    import jax
+    if cpu_smoke:
+        jax.config.update("jax_platforms", "cpu")
+    elif explicit:
+        jax.config.update("jax_platforms", platform)
     # persistent compile cache: repeated bench runs (and the driver's) skip
     # the slow remote TPU compile
     jax.config.update("jax_compilation_cache_dir",
@@ -107,12 +211,18 @@ def main():
     dt = time.perf_counter() - t0
 
     seps = total_edges / dt
-    print(json.dumps({
+    out = {
         "metric": "sampled-edges/sec (ogbn-products-scale, fanout [15,10,5], batch 1024)",
         "value": round(seps, 1),
         "unit": "edges/s",
         "vs_baseline": round(seps / BASELINE_SEPS, 3),
-    }))
+    }
+    if cpu_smoke:
+        # not comparable to the TPU baseline — null the ratio so a parser
+        # that ignores the platform key can't record a bogus comparison
+        out["platform"] = "cpu-smoke"
+        out["vs_baseline"] = None
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
