@@ -147,8 +147,9 @@ class HeteroGraphSageSampler:
                             -1)
                         edge_index = jnp.stack([flat, row])
                         adjs[et] = Adj(
-                            edge_index=edge_index, e_id=flat >= 0,
-                            size=(int(n_id.shape[0]), s))
+                            edge_index=edge_index, e_id=None,
+                            size=(int(n_id.shape[0]), s),
+                            mask=flat >= 0)
                 hops.append((adjs, dict(new_frontier), new_counts))
                 frontier = new_frontier
             return frontier, hops

@@ -70,7 +70,7 @@ def gather_rows(feat: jax.Array, ids: jax.Array,
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(padded // _BLOCK_ROWS,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(
             (_BLOCK_ROWS, dim), lambda b, ids: (b, 0),
             memory_space=pltpu.VMEM),

@@ -148,7 +148,7 @@ def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
             pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec((BLOCK, k), lambda b: (b, 0),

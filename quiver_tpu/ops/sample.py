@@ -48,6 +48,9 @@ class LayerSample(NamedTuple):
               sampled edge; -1 fill
     col:      [num_seeds*k] local index of the sampled neighbor; -1 fill
     edge_count: [] number of valid sampled edges
+    e_id:     [num_seeds*k] global edge id of each sampled edge (-1
+              fill), present only when edge-id tracking was requested
+              (``sample_multihop(..., eid=...)``); None otherwise
     """
 
     n_id: jax.Array
@@ -55,6 +58,7 @@ class LayerSample(NamedTuple):
     row: jax.Array
     col: jax.Array
     edge_count: jax.Array
+    e_id: jax.Array | None = None
 
 
 def _fisher_yates_rows(key: jax.Array, deg: jax.Array, k: int) -> jax.Array:
@@ -102,11 +106,13 @@ def _fisher_yates_rows(key: jax.Array, deg: jax.Array, k: int) -> jax.Array:
 
 
 def sample_layer(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
-                 k: int, key: jax.Array):
+                 k: int, key: jax.Array, with_slots: bool = False):
     """Sample up to ``k`` distinct neighbors for each seed.
 
     seeds may contain -1 fill (masked rows). Returns
-    (neighbors [bs, k] with -1 fill, counts [bs]).
+    (neighbors [bs, k] with -1 fill, counts [bs]); with ``with_slots``
+    additionally the CSR slot of each pick ([bs, k], -1 fill) — the
+    input to edge-id (``eid``) lookups.
     """
     n = indptr.shape[0] - 1
     e = indices.shape[0]
@@ -120,6 +126,8 @@ def sample_layer(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     nbrs = indices[gather].astype(jnp.int32)
     mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
     nbrs = jnp.where(mask, nbrs, -1)
+    if with_slots:
+        return nbrs, counts, jnp.where(mask, gather, -1)
     return nbrs, counts
 
 
@@ -134,12 +142,21 @@ def edge_row_ids(indptr: jax.Array, edge_count: int) -> jax.Array:
 
 
 def permute_csr(indices: jax.Array, row_ids: jax.Array,
-                key: jax.Array) -> jax.Array:
+                key: jax.Array, with_slot_map: bool = False):
     """Uniformly shuffle every CSR row's neighbor list, on device, in one
     2-key sort over the edge array. O(E log E), ~4ms per 1M edges on
     v5e — refresh once per epoch so rotation sampling (below) draws fresh
-    subsets each epoch."""
+    subsets each epoch.
+
+    With ``with_slot_map`` also returns ``slot_map`` where
+    ``slot_map[p]`` = the ORIGINAL CSR slot now stored at permuted
+    position ``p`` (feeds edge-id tracking under rotation sampling)."""
     rand = jax.random.bits(key, (indices.shape[0],)).astype(jnp.int32)
+    if with_slot_map:
+        iota = jnp.arange(indices.shape[0], dtype=jnp.int32)
+        _, _, permuted, slot_map = jax.lax.sort(
+            (row_ids, rand, indices.astype(jnp.int32), iota), num_keys=2)
+        return permuted, slot_map
     _, _, permuted = jax.lax.sort(
         (row_ids, rand, indices.astype(jnp.int32)), num_keys=2)
     return permuted
@@ -157,7 +174,8 @@ def as_index_rows(indices: jax.Array, width: int = 128) -> jax.Array:
 
 
 def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
-                          seeds: jax.Array, k: int, key: jax.Array):
+                          seeds: jax.Array, k: int, key: jax.Array,
+                          with_slots: bool = False):
     """Rotation sampling: draw ``min(deg, k)`` *consecutive* entries of the
     (pre-shuffled) neighbor row at a uniform random offset.
 
@@ -170,11 +188,16 @@ def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
     trade-off; use ``sample_layer`` for i.i.d. exact subsets).
 
     Returns (neighbors [bs, k] -1 fill, counts [bs]).
+
+    The row width is taken from ``indices_rows.shape[1]`` (the
+    ``as_index_rows`` width), so non-default widths work; ``k`` must not
+    exceed it.
     """
-    if k > 128:
+    width = indices_rows.shape[1]
+    if k > width:
         raise ValueError(
-            f"sample_layer_rotation supports k <= 128 (got {k}): the "
-            "two-row window only covers picks [off, off+k) up to a lane")
+            f"sample_layer_rotation supports k <= row width {width} (got "
+            f"{k}): the two-row window only covers picks [off, off+k)")
     n = indptr.shape[0] - 1
     valid = seeds >= 0
     safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
@@ -186,12 +209,12 @@ def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
     span = jnp.maximum(deg - k, 0) + 1
     o = jax.random.randint(key, (bs,), 0, span, dtype=jnp.int32)
     p0 = start + o.astype(start.dtype)
-    r0 = (p0 // 128).astype(jnp.int32)
-    off = (p0 % 128).astype(jnp.int32)
-    # two row-gathers -> a 256-wide window that always covers picks
-    # [off, off + k) since k <= 128
+    r0 = (p0 // width).astype(jnp.int32)
+    off = (p0 % width).astype(jnp.int32)
+    # two row-gathers -> a 2*width window that always covers picks
+    # [off, off + k) since k <= width
     w = jnp.concatenate(
-        [indices_rows[r0], indices_rows[r0 + 1]], axis=1)   # [bs, 256]
+        [indices_rows[r0], indices_rows[r0 + 1]], axis=1)   # [bs, 2*width]
     wiota = jax.lax.broadcasted_iota(jnp.int32, (1, w.shape[1]), 1)
     cols = []
     for j in range(k):
@@ -199,6 +222,11 @@ def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
         cols.append(jnp.sum(jnp.where(onehot, w, 0), axis=1))
     nbrs = jnp.stack(cols, axis=1).astype(jnp.int32)
     mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
+    if with_slots:
+        # pick j sits at flat position p0 + j of the (permuted) edge
+        # array; map through permute_csr's slot_map for original slots
+        slots = p0[:, None] + jnp.arange(k, dtype=p0.dtype)[None, :]
+        return jnp.where(mask, nbrs, -1), counts, jnp.where(mask, slots, -1)
     return jnp.where(mask, nbrs, -1), counts
 
 

@@ -9,8 +9,8 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .sample import (LayerSample, as_index_rows, compact_layer, sample_layer,
-                     sample_layer_rotation)
+from .sample import (LayerSample, as_index_rows, compact_layer, edge_rows,
+                     permute_csr, sample_layer, sample_layer_rotation)
 from .weighted import sample_layer_weighted
 
 
@@ -19,6 +19,7 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                     edge_weight: jax.Array | None = None,
                     method: str = "exact",
                     indices_rows: jax.Array | None = None,
+                    eid=None,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
@@ -26,28 +27,66 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
 
     ``method``: ``"exact"`` (default; i.i.d. Fisher-Yates subsets, k
     scattered loads per seed) or ``"rotation"`` (~3x faster on TPU: two
-    128-wide row fetches per seed; REQUIRES the caller to shuffle rows
-    with ``permute_csr`` — at least once, ideally per epoch — or endpoint
-    neighbors are under-sampled; pass the shuffled array as ``indices``
-    and its ``as_index_rows`` view as ``indices_rows``).
+    128-wide row fetches per seed; rotation draws consecutive runs of the
+    row order, so rows must be shuffled with ``permute_csr`` — at least
+    once, ideally per epoch — or endpoint neighbors are under-sampled;
+    pass the shuffled array as ``indices`` and its ``as_index_rows`` view
+    as ``indices_rows``). If ``indices_rows`` is omitted in rotation
+    mode, one ``permute_csr`` is applied internally so the draw is still
+    marginally uniform — correct but slower per call; callers on the hot
+    path should shuffle once per epoch themselves.
     ``edge_weight`` (CSR-slot-aligned) switches every hop to weighted
     sampling (always exact).
+
+    ``eid`` enables per-edge id tracking (off by default — it adds one
+    scattered gather per sampled edge, which the fused training path
+    doesn't want): ``True`` stamps each sampled edge with its CSR slot;
+    an array stamps ``eid[slot]`` (pass ``CSRTopo.eid`` for original COO
+    positions; under rotation pass the co-permuted map built from
+    ``permute_csr(..., with_slot_map=True)``). The ids land in each
+    ``LayerSample.e_id`` (-1 fill).
     """
     cur = seeds.astype(jnp.int32)
+    track_eid = eid is not None
     if edge_weight is None and method == "rotation" and indices_rows is None:
-        indices_rows = as_index_rows(indices)
+        # the no-arg fallback must not sample consecutive runs of the
+        # caller's (possibly raw CSR) order — that permanently
+        # under-samples row-endpoint neighbors
+        pkey = jax.random.fold_in(key, len(sizes))  # hops use 0..len-1
+        rids = edge_rows(indptr, indices.shape[0])
+        if track_eid:
+            # rotation slots index the permuted array; compose the
+            # caller's eid map with the permutation's slot map
+            permuted, smap = permute_csr(indices, rids, pkey,
+                                         with_slot_map=True)
+            eid = smap if eid is True else jnp.asarray(eid)[smap]
+            indices_rows = as_index_rows(permuted)
+        else:
+            indices_rows = as_index_rows(permute_csr(indices, rids, pkey))
     layers: List[LayerSample] = []
     for i, k in enumerate(sizes):
         sub = jax.random.fold_in(key, i)
+        slots = None
         if edge_weight is not None:
-            nbrs, _ = sample_layer_weighted(indptr, indices, edge_weight,
-                                            cur, k, sub)
+            out = sample_layer_weighted(indptr, indices, edge_weight,
+                                        cur, k, sub, with_slots=track_eid)
         elif method == "rotation":
-            nbrs, _ = sample_layer_rotation(indptr, indices_rows, cur, k,
-                                            sub)
+            out = sample_layer_rotation(indptr, indices_rows, cur, k, sub,
+                                        with_slots=track_eid)
         else:
-            nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
+            out = sample_layer(indptr, indices, cur, k, sub,
+                               with_slots=track_eid)
+        nbrs = out[0]
+        if track_eid:
+            slots = out[2]
         layer = compact_layer(cur, nbrs)
+        if track_eid:
+            flat = slots.reshape(-1)
+            if eid is True:
+                ids = flat
+            else:
+                ids = jnp.asarray(eid)[jnp.clip(flat, 0)]
+            layer = layer._replace(e_id=jnp.where(flat >= 0, ids, -1))
         layers.append(layer)
         cur = layer.n_id
     return cur, layers

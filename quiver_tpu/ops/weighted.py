@@ -23,12 +23,14 @@ import jax.numpy as jnp
 
 def sample_layer_weighted(indptr: jax.Array, indices: jax.Array,
                           weights: jax.Array, seeds: jax.Array, k: int,
-                          key: jax.Array, row_cap: int = 2048):
+                          key: jax.Array, row_cap: int = 2048,
+                          with_slots: bool = False):
     """Per seed: k draws ~ edge weight (with replacement, matching the
     reference). ``weights`` is CSR-slot-aligned (use
     ``csr_weights_from_eid`` for COO-ordered weights). Returns
     (neighbors [bs, k] -1-filled, counts [bs]) with counts = min(deg, k);
-    zero-mass rows come back fully masked."""
+    zero-mass rows come back fully masked. ``with_slots`` additionally
+    returns each pick's CSR slot ([bs, k], -1 fill)."""
     n = indptr.shape[0] - 1
     e = indices.shape[0]
     valid = seeds >= 0
@@ -58,6 +60,9 @@ def sample_layer_weighted(indptr: jax.Array, indices: jax.Array,
         & (total[:, None] > 0)
     nbrs = jnp.where(mask, nbrs, -1)
     counts = jnp.where(total > 0, counts, 0)
+    if with_slots:
+        slots = jnp.clip(start[:, None] + pos.astype(start.dtype), 0, e - 1)
+        return nbrs, counts, jnp.where(mask, slots, -1)
     return nbrs, counts
 
 

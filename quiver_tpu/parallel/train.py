@@ -45,8 +45,9 @@ def layers_to_adjs(layers, batch_size: int, sizes: Sequence[int]):
     adjs = []
     for layer, shape in zip(layers, shapes):
         adjs.append(Adj(edge_index=jnp.stack([layer.col, layer.row]),
-                        e_id=layer.col >= 0,
-                        size=(shape.n_id_cap, shape.num_seeds)))
+                        e_id=layer.e_id,
+                        size=(shape.n_id_cap, shape.num_seeds),
+                        mask=layer.col >= 0))
     return adjs[::-1]
 
 
@@ -134,7 +135,27 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
         in_specs=tuple(specs),
         out_specs=(P(), P()),
         check_vma=False)
-    return jax.jit(mapped)
+    jitted = jax.jit(mapped)
+
+    # shard_map arity is fixed at build time from ``method``; validate the
+    # optional arg up front so a mismatch is a clear TypeError, not an
+    # opaque shard_map/jit arity failure
+    def step(state, feat, forder, indptr, indices, seeds, labels, key,
+             indices_rows=None):
+        if method == "rotation":
+            if indices_rows is None:
+                raise TypeError(
+                    "rotation e2e step requires indices_rows (the shuffled "
+                    "as_index_rows view; refresh per epoch via permute_csr)")
+            return jitted(state, feat, forder, indptr, indices, seeds,
+                          labels, key, indices_rows)
+        if indices_rows is not None:
+            raise TypeError(
+                f"method={method!r} e2e step takes no indices_rows")
+        return jitted(state, feat, forder, indptr, indices, seeds, labels,
+                      key)
+
+    return step
 
 
 def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,

@@ -38,20 +38,27 @@ class Adj:
 
     edge_index: [2, cap_edges] int32, -1 fill; row 0 = source (neighbor)
                 local id, row 1 = target (seed) local id.
-    e_id:       [cap_edges] placeholder (empty semantics, like the
-                reference's ``e_id=[]``); holds the validity mask.
+    e_id:       [cap_edges] global edge id per sampled edge (-1 fill)
+                when the sampler tracks edge ids
+                (``GraphSageSampler(..., with_eid=True)``); ``None``
+                otherwise (the reference ships the same shape empty,
+                sage_sampler.py:143).
+    mask:       [cap_edges] bool validity of each edge slot (equivalent
+                to ``edge_index[0] >= 0``; kept explicit so consumers
+                don't have to rederive it).
     size:       (cap_source_nodes, cap_target_nodes) static capacities —
                 pytree aux data, so Adjs cross jit boundaries safely.
 
     Supports PyG-style destructuring: ``edge_index, e_id, size = adj``.
     """
 
-    __slots__ = ("edge_index", "e_id", "size")
+    __slots__ = ("edge_index", "e_id", "size", "mask")
 
-    def __init__(self, edge_index, e_id, size):
+    def __init__(self, edge_index, e_id, size, mask=None):
         self.edge_index = edge_index
         self.e_id = e_id
         self.size = tuple(size)
+        self.mask = mask if mask is not None else edge_index[0] >= 0
 
     def __iter__(self):
         return iter((self.edge_index, self.e_id, self.size))
@@ -60,11 +67,11 @@ class Adj:
         return self
 
     def tree_flatten(self):
-        return (self.edge_index, self.e_id), self.size
+        return (self.edge_index, self.e_id, self.mask), self.size
 
     @classmethod
     def tree_unflatten(cls, size, leaves):
-        return cls(leaves[0], leaves[1], size)
+        return cls(leaves[0], leaves[1], size, leaves[2])
 
 
 class _LayerShape(NamedTuple):
@@ -89,7 +96,8 @@ class GraphSageSampler:
 
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int],
                  device=None, mode: str = "HBM", seed: int = 0,
-                 edge_weight=None, sampling: str = "exact"):
+                 edge_weight=None, sampling: str = "exact",
+                 with_eid: bool = False):
         if mode not in ("HBM", "HOST", "CPU", "UVA", "GPU"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         # accept reference mode names: UVA -> HOST tier, GPU -> HBM
@@ -114,11 +122,19 @@ class GraphSageSampler:
             sampling = "exact"   # those paths have their own samplers
         if sampling == "rotation" and max(sizes, default=0) > 128:
             raise ValueError("rotation sampling supports fanouts <= 128")
+        # with_eid: stamp every sampled edge with its global edge id
+        # (CSRTopo.eid -> original COO position; CSR slot if no eid map),
+        # delivered in Adj.e_id. Costs one scattered gather per edge, so
+        # it is opt-in; the CPU engine doesn't track slots.
+        if with_eid and mode == "CPU":
+            raise ValueError("with_eid is not supported in CPU mode")
+        self.with_eid = with_eid
         self.sampling = sampling
         self._key = jax.random.key(seed)
         self._placed = None
         self._weight_placed = None
-        self._rot = None          # (permuted_indices, index_rows)
+        self._rot = None          # shuffled as_index_rows view
+        self._rot_eid = None      # slot->edge-id map in permuted coords
         self._row_ids = None
         self._fns = {}
 
@@ -162,17 +178,27 @@ class GraphSageSampler:
         if self._row_ids is None:
             self._row_ids = jax.jit(edge_row_ids, static_argnums=1)(
                 indptr, int(indices.shape[0]))
-        permuted = permute_csr(indices, self._row_ids,
-                               key if key is not None else self.next_key())
+        pkey = key if key is not None else self.next_key()
+        if self.with_eid:
+            permuted, smap = permute_csr(indices, self._row_ids, pkey,
+                                         with_slot_map=True)
+            base = self.csr_topo.eid
+            self._rot_eid = (smap if base is None
+                             else jnp.asarray(base)[smap])
+        else:
+            permuted = permute_csr(indices, self._row_ids, pkey)
         rows = as_index_rows(permuted)
         if self.mode == "HOST":
             # keep the shuffled topology host-resident (the mode exists
             # because indices don't fit HBM); the sampler's row fetches
-            # then stream from host like the exact path's
+            # then stream from host like the exact path's. The E-sized
+            # edge-id map gets the same placement for the same reason.
             try:
                 sh = jax.sharding.SingleDeviceSharding(
                     list(rows.devices())[0], memory_kind="pinned_host")
                 rows = jax.device_put(rows, sh)
+                if self._rot_eid is not None:
+                    self._rot_eid = jax.device_put(self._rot_eid, sh)
             except (ValueError, NotImplementedError):
                 pass
         self._rot = rows
@@ -182,12 +208,22 @@ class GraphSageSampler:
         sizes = self.sizes
         weighted = self.edge_weight is not None
         method = self.sampling
+        eid_mode = "none"
+        if self.with_eid:
+            # rotation always needs the co-permuted map; otherwise the
+            # topo's eid map if present, else raw CSR slots
+            eid_mode = ("map" if (method == "rotation"
+                                  or self.csr_topo.eid is not None)
+                        else "slots")
 
-        def run(indptr, indices, seeds, key, weights=None, rows=None):
+        def run(indptr, indices, seeds, key, weights=None, rows=None,
+                eid_arr=None):
             from ..ops.sample_multihop import sample_multihop
+            eid = {"none": None, "slots": True, "map": eid_arr}[eid_mode]
             return sample_multihop(indptr, indices, seeds, sizes, key,
                                    edge_weight=weights if weighted else None,
-                                   method=method, indices_rows=rows)
+                                   method=method, indices_rows=rows,
+                                   eid=eid)
 
         return jax.jit(run)
 
@@ -218,17 +254,23 @@ class GraphSageSampler:
             if self._rot is None:
                 self.reshuffle()
             rows = self._rot
+            eid_arr = self._rot_eid
         else:
             rows = None
+            eid_arr = (jnp.asarray(self.csr_topo.eid)
+                       if self.with_eid and self.csr_topo.eid is not None
+                       else None)
         n_id, layers = fn(jnp.asarray(indptr), jnp.asarray(indices),
-                          seeds, self.next_key(), self._weight_placed, rows)
+                          seeds, self.next_key(), self._weight_placed, rows,
+                          eid_arr)
         shapes = layer_shapes(bs, self.sizes)
         adjs = []
         for layer, shape in zip(layers, shapes):
             edge_index = jnp.stack([layer.col, layer.row])
             adjs.append(Adj(edge_index=edge_index,
-                            e_id=layer.col >= 0,
-                            size=(shape.n_id_cap, shape.num_seeds)))
+                            e_id=layer.e_id,
+                            size=(shape.n_id_cap, shape.num_seeds),
+                            mask=layer.col >= 0))
         return n_id, bs, adjs[::-1]
 
     def _sample_cpu(self, seeds, bs):
@@ -242,8 +284,9 @@ class GraphSageSampler:
         for (row, col), shape in zip(zip(rows, cols), shapes):
             edge_index = jnp.asarray(np.stack([col, row]))
             adjs.append(Adj(edge_index=edge_index,
-                            e_id=edge_index[0] >= 0,
-                            size=(shape.n_id_cap, shape.num_seeds)))
+                            e_id=None,  # CPU engine doesn't track slots
+                            size=(shape.n_id_cap, shape.num_seeds),
+                            mask=edge_index[0] >= 0))
         return jnp.asarray(n_id), bs, adjs[::-1]
 
     # -- aux ----------------------------------------------------------------
@@ -272,13 +315,15 @@ class GraphSageSampler:
     # -- process sharing (API compat; jax is single-process-per-host) -------
     def share_ipc(self):
         return (self.csr_topo, self.device, self.mode, self.sizes,
-                self.edge_weight, self.sampling)
+                self.edge_weight, self.sampling, self.with_eid)
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
-        csr_topo, device, mode, sizes, edge_weight, sampling = ipc_handle
+        (csr_topo, device, mode, sizes, edge_weight, sampling,
+         with_eid) = ipc_handle
         return cls(csr_topo, sizes, device=device, mode=mode,
-                   edge_weight=edge_weight, sampling=sampling)
+                   edge_weight=edge_weight, sampling=sampling,
+                   with_eid=with_eid)
 
 
 class SampleJob(Generic[T_co]):

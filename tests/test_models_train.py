@@ -16,7 +16,7 @@ from quiver_tpu.models import GraphSAGE, GAT
 from quiver_tpu.parallel import (
     TrainState, build_train_step, build_e2e_train_step, make_mesh)
 from quiver_tpu.parallel.train import init_state, layers_to_adjs
-from quiver_tpu.ops import sample_multihop
+from quiver_tpu.ops import sample_multihop, as_index_rows
 
 
 def community_graph(rng, n=240, classes=3, dim=16, p_in=0.12, p_out=0.01):
@@ -181,6 +181,30 @@ class TestDataParallelTraining:
             losses.append(float(loss))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+    def test_e2e_step_arity_validated(self, planted):
+        # ADVICE r1: arity mismatch must be a clear TypeError, not an
+        # opaque shard_map error
+        import pytest
+        sizes, per_dev = [3], 8
+        topo, model, tx, state, feat, labels = _setup(planted, sizes,
+                                                      per_dev)
+        mesh = make_mesh(("data",))
+        n_dev = mesh.devices.size
+        indptr, indices = (jnp.asarray(topo.indptr),
+                           jnp.asarray(topo.indices))
+        seeds = jnp.arange(n_dev * per_dev, dtype=jnp.int32)
+        y = jnp.asarray(labels[np.asarray(seeds)])
+        exact = build_e2e_train_step(model, tx, sizes, per_dev, mesh)
+        rot = build_e2e_train_step(model, tx, sizes, per_dev, mesh,
+                                   method="rotation")
+        rows = as_index_rows(indices)
+        with pytest.raises(TypeError, match="requires indices_rows"):
+            rot(state, feat, None, indptr, indices, seeds, y,
+                jax.random.key(0))
+        with pytest.raises(TypeError, match="takes no indices_rows"):
+            exact(state, feat, None, indptr, indices, seeds, y,
+                  jax.random.key(0), rows)
 
     def test_dp_grads_match_single_chip_average(self, planted):
         # one DP step with identical per-device batches == single-chip step

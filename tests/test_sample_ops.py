@@ -146,6 +146,56 @@ class TestRotationSampler:
         freq = hits / hits.sum()
         np.testing.assert_allclose(freq, 0.1, atol=0.02)
 
+    def test_nondefault_row_width(self, small_graph):
+        # width is taken from indices_rows.shape[1]; a 256-wide view must
+        # give valid members/counts just like the default 128
+        from quiver_tpu.ops import sample_layer_rotation, as_index_rows
+        indptr, indices = small_graph
+        nsets = neighbor_sets(indptr, indices)
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        rows = as_index_rows(jnp.asarray(indices), width=256)
+        assert rows.shape[1] == 256
+        nbrs, counts = sample_layer_rotation(
+            jnp.asarray(indptr), rows, jnp.asarray(seeds), 5, KEY)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        np.testing.assert_array_equal(counts,
+                                      np.minimum(np.diff(indptr), 5))
+        for i, v in enumerate(seeds):
+            got = nbrs[i][nbrs[i] >= 0]
+            assert len(got) == counts[i]
+            assert set(got.tolist()) <= nsets[v]
+
+    def test_multihop_rotation_fallback_is_shuffled(self):
+        # ADVICE r1 (medium): rotation with indices_rows=None must not
+        # sample consecutive runs of the raw CSR order — the fallback now
+        # permutes internally, so the LAST row entry (endpoint) must be
+        # drawn with full marginal frequency, not be under-sampled
+        from quiver_tpu.ops import sample_multihop
+        # 8 seed nodes, each with the SAME raw neighbor row [8..17]
+        n_seed, n_nbr = 8, 10
+        indptr = np.zeros(19, np.int64)
+        indptr[1:n_seed + 1] = np.arange(1, n_seed + 1) * n_nbr
+        indptr[n_seed + 1:] = n_seed * n_nbr
+        indices = np.tile(np.arange(8, 18), n_seed)
+        seeds = jnp.arange(n_seed, dtype=jnp.int32)
+        hits = np.zeros(n_nbr)
+        for t in range(40):
+            _, layers = sample_multihop(jnp.asarray(indptr),
+                                        jnp.asarray(indices), seeds, [2],
+                                        jax.random.fold_in(KEY, 7000 + t),
+                                        method="rotation")
+            l = layers[0]
+            col = np.asarray(l.col)
+            nid = np.asarray(l.n_id)
+            picked = nid[col[col >= 0]] - 8
+            ids, cnt = np.unique(picked, return_counts=True)
+            hits[ids] += cnt
+        freq = hits / hits.sum()
+        # raw-order rotation gives row-endpoint ids ~1/2 the mass of
+        # interior ids (0.056 vs 0.111); the internal shuffle restores
+        # uniformity
+        np.testing.assert_allclose(freq, 1 / n_nbr, atol=0.025)
+
     def test_permute_csr_preserves_rows(self, small_graph):
         from quiver_tpu.ops import permute_csr, edge_row_ids
         indptr, indices = small_graph

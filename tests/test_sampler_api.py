@@ -94,6 +94,77 @@ class TestGraphSageSamplerHBM:
             qv.GraphSageSampler(topo, [200], sampling="rotation")
 
 
+def _coo_graph(rng, n=120, e=900):
+    coo = rng.integers(0, n, (2, e))
+    return coo, qv.CSRTopo(edge_index=coo, node_count=n)
+
+
+def check_eids(coo, n_id, adjs):
+    """Every valid sampled edge's e_id must name the original COO edge
+    (src == that hop's seed, dst == the sampled neighbor)."""
+    n_id = np.asarray(n_id)
+    checked = 0
+    for adj in adjs:
+        ei = np.asarray(adj.edge_index)
+        eid = np.asarray(adj.e_id)
+        mask = np.asarray(adj.mask)
+        assert eid.shape == ei[0].shape
+        np.testing.assert_array_equal(mask, ei[0] >= 0)
+        np.testing.assert_array_equal(eid >= 0, mask)
+        for j in np.nonzero(mask)[0]:
+            src_global = n_id[ei[1, j]]   # seed (target in PyG orient.)
+            dst_global = n_id[ei[0, j]]   # sampled neighbor
+            g = eid[j]
+            assert coo[0, g] == src_global
+            assert coo[1, g] == dst_global
+            checked += 1
+    assert checked > 0
+
+
+class TestEdgeIdTracking:
+    def test_exact_mode_eids_name_coo_edges(self, rng):
+        coo, topo = _coo_graph(rng)
+        sampler = qv.GraphSageSampler(topo, sizes=[4, 3], with_eid=True)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_eids(coo, n_id, adjs)
+
+    def test_rotation_mode_eids_survive_reshuffle(self, rng):
+        coo, topo = _coo_graph(rng)
+        sampler = qv.GraphSageSampler(topo, sizes=[4, 3],
+                                      sampling="rotation", with_eid=True)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_eids(coo, n_id, adjs)
+        sampler.reshuffle()
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_eids(coo, n_id, adjs)
+
+    def test_weighted_mode_eids(self, rng):
+        from quiver_tpu.ops.weighted import csr_weights_from_eid
+        coo, topo = _coo_graph(rng)
+        w = csr_weights_from_eid(topo.eid,
+                                 rng.uniform(0.1, 1.0, coo.shape[1]))
+        sampler = qv.GraphSageSampler(topo, sizes=[4], edge_weight=w,
+                                      with_eid=True)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_eids(coo, n_id, adjs)
+
+    def test_default_off_e_id_is_none(self, rng):
+        coo, topo = _coo_graph(rng)
+        sampler = qv.GraphSageSampler(topo, sizes=[4])
+        seeds = rng.choice(topo.node_count, 8, replace=False)
+        _, _, adjs = sampler.sample(seeds)
+        assert all(adj.e_id is None for adj in adjs)
+        assert all(adj.mask is not None for adj in adjs)
+
+    def test_cpu_mode_rejects_with_eid(self, rng):
+        _, topo = _coo_graph(rng)
+        with pytest.raises(ValueError):
+            qv.GraphSageSampler(topo, [4], mode="CPU", with_eid=True)
+
+
 class TestNativeCPUEngine:
     def test_native_lib_builds(self):
         assert get_lib() is not None, "g++ build of cpu_sampler.cpp failed"
