@@ -35,6 +35,7 @@ from .partition import (
 from .hetero import HeteroCSRTopo, HeteroGraphSageSampler
 from .async_sampler import AsyncNeighborSampler, AsyncCudaNeighborSampler
 from .debug import show_tensor_info
+from .inference import layerwise_inference
 from . import comm, profiling, checkpoint, debug
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
@@ -72,4 +73,5 @@ __all__ = [
     "AsyncNeighborSampler",
     "AsyncCudaNeighborSampler",
     "show_tensor_info",
+    "layerwise_inference",
 ]

@@ -6,34 +6,40 @@ train_quiver_multi_node.py:379): compute exact (non-sampled) embeddings
 layer by layer over all nodes, batching nodes per step so the full graph
 never needs to fit activation memory.
 
-TPU design: per layer, nodes are processed in fixed-size batches; each
-batch gathers its FULL in-neighborhood rows (capped at ``max_degree``
-with masking — exact for graphs whose max in-degree fits, top-``max_
-degree`` truncation otherwise), so each layer is one jitted program run
-repeatedly.
+TPU design: per layer, nodes are processed in fixed-size batches. Each
+batch's in-neighborhood is reduced over ``ceil(max_deg_in_batch /
+max_degree)`` fixed-shape windows of ``max_degree`` neighbors, so the
+aggregation is EXACT for arbitrary degree (ogbn-products hub nodes reach
+tens of thousands of neighbors) while every dispatch keeps a static
+[batch, max_degree] shape. Non-hub batches take exactly one window, so
+the common case costs the same as a fixed-cap gather.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable, Sequence
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-def neighborhood_block(indptr, indices, nodes, max_degree):
-    """For each node: its in-neighbors padded to [bs, max_degree]."""
+def neighborhood_block(indptr, indices, nodes, max_degree, window=0):
+    """For each node: in-neighbors at row positions
+    [window*max_degree, (window+1)*max_degree), padded/masked to
+    [bs, max_degree]. ``window`` may be a traced scalar."""
     n = indptr.shape[0] - 1
     e = indices.shape[0]
     safe = jnp.clip(nodes, 0, n - 1).astype(indptr.dtype)
-    start = indptr[safe]
-    deg = (indptr[safe + 1] - start).astype(jnp.int32)
+    base = jnp.asarray(window, indptr.dtype) * max_degree
+    start = indptr[safe] + base
+    deg = (indptr[safe + 1] - indptr[safe]).astype(jnp.int32)
+    rel = deg - base.astype(jnp.int32)
     offs = jnp.arange(max_degree, dtype=jnp.int32)[None, :]
     gather = jnp.clip(start[:, None] + offs, 0, e - 1)
     nbrs = indices[gather].astype(jnp.int32)
-    mask = (offs < deg[:, None]) & (nodes >= 0)[:, None]
+    mask = (offs < rel[:, None]) & (nodes >= 0)[:, None]
     return jnp.where(mask, nbrs, -1), deg
 
 
@@ -43,31 +49,56 @@ def layerwise_inference(apply_layer: Callable, indptr, indices,
                         max_degree: int = 256) -> jax.Array:
     """Run ``num_layers`` rounds of exact message passing.
 
-    ``apply_layer(layer_idx, x_self, x_nbrs, nbr_mask) -> new_x`` computes
-    one layer for a node batch given [bs, F] self features and
-    [bs, max_degree, F] neighbor features (masked).
+    ``apply_layer(layer_idx, x_self, mean_agg) -> new_x`` computes one
+    layer for a node batch given its [bs, F] self features and the
+    [bs, F] EXACT mean of all neighbor features (zeros for isolated
+    nodes). The mean is accumulated here over degree windows, so no
+    degree cap applies — ``max_degree`` only sets the window width
+    (dispatch granularity), not a truncation.
     """
     n = indptr.shape[0] - 1
+    host_indptr = np.asarray(indptr)
+    if (host_indptr.dtype == np.int64
+            and host_indptr[-1] > np.iinfo(np.int32).max
+            and not jax.config.jax_enable_x64):
+        raise ValueError(
+            "layerwise_inference: edge offsets exceed int32 in 32-bit jax "
+            "mode; jnp.asarray would silently wrap them — enable "
+            "jax_enable_x64 or run inference shard-wise (each shard's "
+            "local edge count < 2^31)")
+    host_deg = host_indptr[1:] - host_indptr[:-1]
     indptr = jnp.asarray(indptr)
     indices = jnp.asarray(indices)
 
+    @jax.jit
+    def window_sum(x_all, nodes, w, acc):
+        nbrs, _ = neighborhood_block(indptr, indices, nodes, max_degree, w)
+        xn = x_all[jnp.clip(nbrs, 0, n - 1)]
+        m = (nbrs >= 0).astype(x_all.dtype)
+        return acc + (xn * m[:, :, None]).sum(axis=1)
+
     @functools.partial(jax.jit, static_argnums=0)
-    def run_batch(layer_idx, x_all, nodes):
-        nbrs, _deg = neighborhood_block(indptr, indices, nodes, max_degree)
-        x_self = x_all[jnp.clip(nodes, 0, n - 1)]
-        x_nbrs = x_all[jnp.clip(nbrs, 0, n - 1)]
-        mask = (nbrs >= 0).astype(x_all.dtype)
-        return apply_layer(layer_idx, x_self, x_nbrs, mask)
+    def finalize(layer_idx, x_all, nodes, acc):
+        safe = jnp.clip(nodes, 0, n - 1)
+        deg = (indptr[safe + 1] - indptr[safe]).astype(x_all.dtype)
+        mean = acc / jnp.maximum(deg, 1.0)[:, None]
+        return apply_layer(layer_idx, x_all[safe], mean)
 
     for layer in range(num_layers):
         outs = []
         for lo in range(0, n, batch_size):
-            nodes = jnp.arange(lo, min(lo + batch_size, n), dtype=jnp.int32)
+            hi = min(lo + batch_size, n)
+            nodes = jnp.arange(lo, hi, dtype=jnp.int32)
             if nodes.shape[0] < batch_size:
                 nodes = jnp.concatenate([
                     nodes, jnp.full((batch_size - nodes.shape[0],), -1,
                                     jnp.int32)])
-            outs.append(run_batch(layer, x, nodes))
+            windows = max(1, -(-int(host_deg[lo:hi].max(initial=0))
+                               // max_degree))
+            acc = jnp.zeros((batch_size, x.shape[1]), x.dtype)
+            for w in range(windows):
+                acc = window_sum(x, nodes, jnp.int32(w), acc)
+            outs.append(finalize(layer, x, nodes, acc))
         x = jnp.concatenate(outs)[:n]
     return x
 
@@ -75,12 +106,10 @@ def layerwise_inference(apply_layer: Callable, indptr, indices,
 def sage_apply_layer(params_list, activation=jax.nn.relu):
     """apply_layer for a stack of SAGEConv params
     ({'lin_root': {kernel, bias}, 'lin_nbr': {kernel}})."""
-    def apply(layer_idx, x_self, x_nbrs, mask):
+    def apply(layer_idx, x_self, mean_nbr):
         p = params_list[layer_idx]
-        cnt = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
-        mean = (x_nbrs * mask[:, :, None]).sum(axis=1) / cnt
         h = x_self @ p["lin_root"]["kernel"] + p["lin_root"]["bias"]
-        h = h + mean @ p["lin_nbr"]["kernel"]
+        h = h + mean_nbr @ p["lin_nbr"]["kernel"]
         if layer_idx < len(params_list) - 1:
             h = activation(h)
         return h

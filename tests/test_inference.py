@@ -61,6 +61,36 @@ class TestLayerwiseInference:
             cur = nxt
         np.testing.assert_allclose(got, cur, rtol=2e-4, atol=2e-4)
 
+    def test_exact_for_hub_nodes_beyond_max_degree(self, rng):
+        # VERDICT r1: the old implementation silently truncated at
+        # max_degree; a degree >> max_degree hub must now be aggregated
+        # exactly via window accumulation
+        n, f, h = 80, 5, 4
+        hub_deg = 2000
+        deg = rng.integers(0, 6, n)
+        deg[0] = hub_deg                      # hub: 2000 >> max_degree 64
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]))
+        x = rng.standard_normal((n, f)).astype(np.float32)
+        params = [
+            {"lin_root": {"kernel": rng.standard_normal((f, h)).astype(np.float32),
+                          "bias": rng.standard_normal(h).astype(np.float32)},
+             "lin_nbr": {"kernel": rng.standard_normal((f, h)).astype(np.float32)}},
+        ]
+        got = np.asarray(layerwise_inference(
+            sage_apply_layer(params), indptr, indices, jnp.asarray(x),
+            num_layers=1, batch_size=32, max_degree=64))
+        mean = np.zeros_like(x)
+        for v in range(n):
+            row = indices[indptr[v]:indptr[v + 1]]
+            if len(row):
+                mean[v] = x[row].mean(axis=0)
+        want = x @ params[0]["lin_root"]["kernel"] \
+            + params[0]["lin_root"]["bias"] \
+            + mean @ params[0]["lin_nbr"]["kernel"]
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
     def test_uses_flax_sage_params(self, rng):
         # params trained via models.GraphSAGE slot straight in
         from quiver_tpu.models import GraphSAGE
