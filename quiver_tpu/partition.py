@@ -52,7 +52,10 @@ def partition_feature_without_replication(
                 break
             order = np.argsort(-score[idx], kind="stable")[:take]
             res[idx].append(chunk[order])
-            score[:, order] = -1.0
+            # -inf, NOT a finite sentinel: genuine scores reach
+            # own*P - others ~ -(P-1), so any finite marker could rank
+            # above real entries and double-assign nodes
+            score[:, order] = -np.inf
             assigned += take
         start_partition += 1
         pos = end

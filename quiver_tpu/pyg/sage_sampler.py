@@ -146,6 +146,12 @@ class GraphSageSampler:
             self._placed = (np.asarray(self.csr_topo.indptr),
                             np.asarray(self.csr_topo.indices))
             return
+        if getattr(self.csr_topo, "requires_host_sampling", lambda: False)():
+            raise ValueError(
+                "topology offsets exceed int32 in 32-bit jax mode; device "
+                "sampling would silently wrap them — use mode='CPU' (the "
+                "native host engine handles int64 offsets) or enable "
+                "jax_enable_x64")
         dev = self.device
         if dev is None or isinstance(dev, int):
             platforms = [d for d in jax.devices()]

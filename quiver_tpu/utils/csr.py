@@ -6,7 +6,10 @@ TPU-native equivalent of the reference ``CSRTopo`` (utils.py:120-226) and
 - arrays are jnp (device-resident) pytree leaves, not torch CPU tensors;
   COO->CSR runs on-device via stable argsort + searchsorted (no scipy).
 - node ids default to int32 (TPU-preferred); ``indptr`` widens to int64
-  only when edge_count >= 2**31 (mixed-width CSR, survey §7.3.7).
+  only when edge_count >= 2**31 (mixed-width CSR, survey §7.3.7). In
+  jax's default 32-bit mode such a topology stays HOST-RESIDENT (numpy;
+  memmaps pass through zero-copy) because jnp would silently truncate
+  the offsets — the HOST/CPU sampling paths consume it directly.
 - isolated tail nodes are kept when ``node_count`` is passed explicitly
   (the reference silently drops them, a known quirk — survey §7.4).
 """
@@ -82,10 +85,26 @@ class CSRTopo:
         elif indptr is not None and indices is not None:
             e = int(np.asarray(jnp.shape(indices))[0]) if hasattr(indices, "shape") else len(indices)
             ptr_dtype = index_dtype_for(max(e, 1))
-            self._indptr = _as_jnp(indptr, ptr_dtype)
-            n = int(self._indptr.shape[0]) - 1
-            self._indices = _as_jnp(indices, index_dtype_for(max(n, 1)))
-            self._eid = _as_jnp(eid, ptr_dtype)
+            if ptr_dtype == jnp.int64 and not jax.config.jax_enable_x64:
+                # >2^31 edge offsets but jax is in default 32-bit mode:
+                # jnp.asarray would SILENTLY truncate indptr to int32.
+                # Keep the topology host-resident as numpy (memmaps pass
+                # through zero-copy) — at this scale sampling runs on the
+                # HOST/CPU paths anyway (the reference equally keeps
+                # papers100M topology out of device memory via UVA,
+                # quiver_sample.cu:412-453).
+                n = (indptr.shape[0] if hasattr(indptr, "shape")
+                     else len(indptr)) - 1
+                self._indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+                self._indices = np.ascontiguousarray(
+                    indices, dtype=np.int32 if n <= INT32_MAX else np.int64)
+                self._eid = (None if eid is None
+                             else np.ascontiguousarray(eid, dtype=np.int64))
+            else:
+                self._indptr = _as_jnp(indptr, ptr_dtype)
+                n = int(self._indptr.shape[0]) - 1
+                self._indices = _as_jnp(indices, index_dtype_for(max(n, 1)))
+                self._eid = _as_jnp(eid, ptr_dtype)
         else:
             raise ValueError("provide either edge_index or indptr+indices")
         self._feature_order = None
@@ -137,9 +156,23 @@ class CSRTopo:
     def share_memory_(self):
         return self
 
+    def requires_host_sampling(self) -> bool:
+        """True when the topology's offsets exceed int32 and jax is in
+        default 32-bit mode — the arrays must stay host-side numpy
+        (device placement would silently wrap the offsets)."""
+        return (isinstance(self._indptr, np.ndarray)
+                and self._indptr.dtype == np.int64
+                and not jax.config.jax_enable_x64)
+
     def device_put(self, sharding_or_device=None):
         """Place topology arrays (HBM by default; pass a Sharding with
         ``memory_kind='pinned_host'`` for the host/zero-copy tier)."""
+        if self.requires_host_sampling():
+            raise ValueError(
+                "this topology's edge offsets exceed int32 and jax is in "
+                "default 32-bit mode: jax.device_put would silently wrap "
+                "them. Keep it host-resident (mode='CPU' sampling) or "
+                "enable jax_enable_x64.")
         put = lambda x: None if x is None else jax.device_put(x, sharding_or_device)
         obj = CSRTopo.__new__(CSRTopo)
         obj._indptr = put(self._indptr)

@@ -1,0 +1,127 @@
+"""papers100M-class scale smoke: the mixed-width CSR path actually
+engages (int64 indptr over >2^31 edge offsets) end to end.
+
+The reference handles this scale with UVA zero-copy + multi-node
+pipelines (benchmarks/ogbn-papers100M/preprocess.py,
+train_quiver_multi_node.py); here the topology lives in a host-side
+memmap and the native C++ engine samples it zero-copy (int64 row
+offsets, int32 node ids — survey §7.3.7's mixed-width plan).
+
+Marked slow: writes an ~8.6 GB indices file to disk (deleted on exit).
+CI runs it via the dedicated slow job; the default suite skips it.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu.native import cpu_sample_layer, cpu_sample_multihop
+from quiver_tpu.utils.csr import index_dtype_for
+
+E_TOTAL = (1 << 31) + 4_096          # crosses the int32 offset boundary
+N_NODES = 1_000_000
+CHUNK = 1 << 24                      # 16M int32 = 64MB write chunks
+
+
+@pytest.fixture(scope="module")
+def big_graph(tmp_path_factory):
+    """Memmapped CSR with >2^31 edges: every node has degree
+    E_TOTAL // N_NODES (the last node takes the remainder), neighbor ids
+    follow a cheap deterministic pattern (i * 2654435761 % N)."""
+    path = tmp_path_factory.mktemp("papers100m") / "indices.i32"
+    deg = E_TOTAL // N_NODES
+    indptr = np.arange(N_NODES + 1, dtype=np.int64) * deg
+    indptr[-1] = E_TOTAL                 # tail remainder on the last node
+    mm = np.memmap(path, dtype=np.int32, mode="w+", shape=(E_TOTAL,))
+    # Knuth-hash pattern: cheap, deterministic, covers the id range
+    for lo in range(0, E_TOTAL, CHUNK):
+        hi = min(lo + CHUNK, E_TOTAL)
+        i = np.arange(lo, hi, dtype=np.uint64)
+        mm[lo:hi] = ((i * np.uint64(2654435761)) % np.uint64(N_NODES)
+                     ).astype(np.int32)
+    mm.flush()
+    yield indptr, mm
+    del mm
+    os.unlink(path)
+
+
+@pytest.mark.slow
+class TestPapers100MScale:
+    def test_indptr_widens_to_int64(self, big_graph):
+        indptr, _ = big_graph
+        assert index_dtype_for(E_TOTAL) == jnp.int64
+        assert index_dtype_for(np.iinfo(np.int32).max) == jnp.int32
+        assert indptr.dtype == np.int64
+        assert int(indptr[-1]) > np.iinfo(np.int32).max
+
+    def test_native_sampling_beyond_2g_offsets(self, big_graph):
+        # seeds whose CSR rows start beyond the 2^31 offset boundary:
+        # the sampler must read the right slice through int64 arithmetic
+        indptr, mm = big_graph
+        deg = E_TOTAL // N_NODES
+        first_beyond = int(np.searchsorted(
+            indptr, np.iinfo(np.int32).max, side="right"))
+        seeds = np.arange(first_beyond,
+                          min(first_beyond + 64, N_NODES), dtype=np.int32)
+        nbrs, counts = cpu_sample_layer(indptr, mm, seeds, 8, seed=7)
+        np.testing.assert_array_equal(counts, np.minimum(deg, 8))
+        for i, v in enumerate(seeds):
+            row = np.asarray(mm[indptr[v]:indptr[v + 1]])
+            got = nbrs[i][nbrs[i] >= 0]
+            assert set(got.tolist()) <= set(row.tolist()), \
+                f"seed {v}: sampled ids not from its (beyond-2^31) row"
+
+    def test_multihop_and_first_vs_last_rows(self, big_graph):
+        indptr, mm = big_graph
+        seeds = np.concatenate([
+            np.arange(16, dtype=np.int32),                 # offsets < 2^31
+            np.arange(N_NODES - 16, N_NODES, dtype=np.int32),  # > 2^31
+        ])
+        n_id, rows, cols = cpu_sample_multihop(indptr, mm, seeds, [4, 4],
+                                               seed=3)
+        valid = n_id[n_id >= 0]
+        assert len(np.unique(valid)) == len(valid)
+        np.testing.assert_array_equal(valid[:len(seeds)], seeds)
+        assert all((r >= -1).all() for r in rows)
+
+    def test_csrtopo_mixed_width(self, big_graph):
+        # the REAL constructor at the REAL scale: int64 indptr pairs with
+        # int32 node-id indices (mixed-width CSR). In 32-bit jax mode the
+        # constructor keeps the arrays HOST-RESIDENT numpy (the memmap
+        # passes through zero-copy; jnp would silently wrap the offsets),
+        # and every device-placement door refuses loudly.
+        indptr, mm = big_graph
+        topo = qv.CSRTopo(indptr=indptr, indices=mm)
+        assert topo.indptr.dtype == np.int64
+        assert isinstance(topo.indptr, np.ndarray)
+        assert topo.indices.dtype == np.int32
+        assert topo.node_count == N_NODES
+        assert topo.edge_count == E_TOTAL
+        assert topo.requires_host_sampling()
+        d = np.asarray(topo.degree[:4])
+        np.testing.assert_array_equal(d, E_TOTAL // N_NODES)
+        with pytest.raises(ValueError, match="host"):
+            topo.device_put()
+        with pytest.raises(ValueError, match="CPU"):
+            qv.GraphSageSampler(topo, [4], mode="HBM").lazy_init_quiver()
+        # CPU mode keeps working
+        s = qv.GraphSageSampler(topo, [4], mode="CPU")
+        n_id, bs, adjs = s.sample(np.arange(8, dtype=np.int32))
+        assert bs == 8
+
+    def test_partitioner_at_100m_node_scale(self, big_graph):
+        # the papers100M preprocess partitions 111M nodes by access prob;
+        # run the same chunked greedy partitioner at 1M-node scale
+        indptr, _ = big_graph
+        rng = np.random.default_rng(0)
+        probs = [rng.random(N_NODES).astype(np.float32) for _ in range(4)]
+        parts, _ = qv.partition_feature_without_replication(probs)
+        sizes = np.array([len(p) for p in parts])
+        assert sizes.sum() == N_NODES
+        # chunk-round-robin keeps partitions balanced
+        assert sizes.max() - sizes.min() <= 4 * 256
+        all_ids = np.concatenate([np.asarray(p) for p in parts])
+        assert len(np.unique(all_ids)) == N_NODES
