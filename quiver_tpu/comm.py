@@ -112,6 +112,70 @@ def build_exchange_fn(mesh: Mesh, axis: str, rows_per_host: int, cap: int,
     return jax.jit(mapped)
 
 
+def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
+                         batch_per_host: int, dim: int, dtype=jnp.float32,
+                         with_replicate: bool = False):
+    """The WHOLE DistFeature lookup as one jitted SPMD program
+    (reference feature.py:555-567 dispatch + comm.py:127-182 exchange +
+    scatter, fused):
+
+      ids  [H*B] global node ids, -1 fill, sharded over ``axis``
+      g2h  [N]   node -> owning host            (replicated)
+      loc  [N]   node -> local row on its owner (replicated)
+      feat [H*rows_per_host, dim] row-sharded over ``axis``
+      -> out [H*B, dim] sharded over ``axis`` (zeros at -1 fill)
+
+    Per shard: bucket ids by owner (one-hot + cumsum — jittable, no host
+    round trip), scatter into a [H, B] request block, one ``all_to_all``
+    ships requests, a local gather reads rows, a second ``all_to_all``
+    ships responses, and a final gather unbuckets them into batch order.
+
+    With ``with_replicate`` the program takes three extra replicated
+    operands (is_rep [N] bool, rep_rank [N], bases [H]) and resolves
+    replicated nodes against the calling host's replica tail
+    (reference feature.py:510-526's replicate override).
+    """
+    h_count = mesh.shape[axis]
+
+    def body(ids, g2h, loc, feat, *rep):
+        ids = ids.reshape(-1)                                   # [B]
+        valid = ids >= 0
+        safe = jnp.clip(ids, 0)
+        owner = jnp.where(valid, g2h[safe], -1)                 # [B]
+        local = loc[safe]                                       # [B]
+        if rep:
+            # replicated nodes resolve locally: owner := this host,
+            # local := this host's replica-tail base + rank in the set
+            is_rep, rep_rank, bases = rep
+            me = jax.lax.axis_index(axis).astype(owner.dtype)
+            r = is_rep[safe]
+            owner = jnp.where(valid & r, me, owner)
+            local = jnp.where(r, bases[me] + rep_rank[safe], local)
+        onehot = owner[None, :] == jnp.arange(
+            h_count, dtype=owner.dtype)[:, None]                # [H, B]
+        bucket_pos = jnp.cumsum(onehot, axis=1) - 1             # [H, B]
+        my_pos = jnp.sum(jnp.where(onehot, bucket_pos, 0), axis=0)  # [B]
+        req = jnp.zeros((h_count, batch_per_host), jnp.int32).at[
+            owner, my_pos].set(local, mode="drop")   # owner=-1 -> dropped
+        incoming = jax.lax.all_to_all(
+            req, axis, split_axis=0, concat_axis=0)             # [H, B]
+        rows = feat[jnp.clip(incoming, 0, rows_per_host - 1)]   # [H, B, d]
+        resp = jax.lax.all_to_all(
+            rows, axis, split_axis=0, concat_axis=0)            # [H, B, d]
+        out = resp[jnp.clip(owner, 0), my_pos]                  # [B, d]
+        return jnp.where(valid[:, None], out, 0).astype(dtype)
+
+    specs = (P(axis), P(), P(), P(axis))
+    if with_replicate:
+        specs += (P(), P(), P())
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=specs,
+        out_specs=P(axis),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
 class TpuComm:
     """Cross-host exchange driver with the reference ``NcclComm`` surface
     (rank/world_size, allreduce, exchange; quiver_comm.cu:17-86 +
@@ -163,12 +227,12 @@ class TpuComm:
                 continue
             if h in self.peers:
                 results[h] = self.peers[h][jnp.asarray(host_ids[h])]
-            elif self.world_size == 1:
-                raise ValueError(f"no peer registered for host {h}")
             else:
-                raise NotImplementedError(
-                    "multi-controller exchange goes through "
-                    "exchange_spmd() under a global mesh")
+                raise ValueError(
+                    f"no peer registered for host {h} and no mesh-driven "
+                    "path engaged: under a mesh, use DistFeature (its "
+                    "lookup runs the fused SPMD exchange) or "
+                    "exchange_spmd()/build_dist_lookup_fn directly")
         return results
 
     def exchange_spmd(self, req_ids: jax.Array, feat: jax.Array,

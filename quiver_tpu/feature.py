@@ -337,14 +337,97 @@ class DistFeature:
     """Cross-host feature lookup = dispatch -> collective exchange -> local
     gather -> scatter (reference feature.py:529-567). The hand-scheduled
     NCCL send/recv protocol is replaced by one ``all_to_all`` pair over the
-    mesh's host axis (see ``quiver_tpu.comm.TpuComm.exchange_feature``)."""
+    mesh's host axis.
 
-    def __init__(self, feature: Feature, info: PartitionInfo, comm):
+    Two modes:
+    - **SPMD** (``from_partition`` under a mesh): ``dist[ids]`` with
+      ``ids`` the concatenated per-host batches [H*B] (-1 fill ok) runs
+      dispatch + exchange + scatter as ONE jitted program
+      (``comm.build_dist_lookup_fn``) — the production multi-host path;
+      identical on a virtual CPU mesh, a TPU slice, or multi-slice DCN.
+    - **local/peers** (a ``Feature`` + optional in-process peer registry):
+      host-driven dispatch for single-process tests of the protocol.
+    """
+
+    def __init__(self, feature: Optional[Feature], info: PartitionInfo,
+                 comm):
         self.feature = feature
         self.info = info
         self.comm = comm
+        self._spmd_feat = None         # [H*rows_per_host, dim], P(axis)
+        self._rows_per_host = None
+        self._lookup_fns = {}
+        self._rep_args = None
+
+    @classmethod
+    def from_partition(cls, feat, info: PartitionInfo, comm,
+                       dtype=None) -> "DistFeature":
+        """Build the SPMD store from the FULL feature array + partition
+        metadata: each host's rows land in its shard (replicated nodes
+        also in every host's tail), row-sharded over ``comm.mesh``."""
+        if comm.mesh is None:
+            raise ValueError("from_partition needs a comm with a mesh")
+        feat = np.asarray(feat)
+        if dtype is not None:
+            feat = feat.astype(dtype)
+        hosts = info.hosts
+        g2h = np.asarray(jax.device_get(info.global2host))
+        rep = (None if info.replicate is None
+               else np.asarray(jax.device_get(info.replicate)))
+        rep_rows = 0 if rep is None else rep.size
+        rows_per_host = max(s + rep_rows for s in info.local_sizes)
+        dim = feat.shape[1]
+        store = np.zeros((hosts, rows_per_host, dim), feat.dtype)
+        for h in range(hosts):
+            owned = np.flatnonzero(g2h == h)
+            store[h, :owned.size] = feat[owned]
+            if rep is not None:
+                base = info.local_sizes[h]
+                store[h, base:base + rep_rows] = feat[rep]
+        axis = comm.axis
+        sharding = NamedSharding(comm.mesh, P(axis))
+        self = cls(None, info, comm)
+        self._spmd_feat = jax.device_put(
+            store.reshape(hosts * rows_per_host, dim), sharding)
+        self._rows_per_host = rows_per_host
+        if rep is not None:
+            n = info.node_count
+            is_rep = np.zeros(n, bool)
+            is_rep[rep] = True
+            rep_rank = np.zeros(n, np.int32)
+            rep_rank[rep] = np.arange(rep_rows, dtype=np.int32)
+            bases = np.asarray(info.local_sizes, np.int32)
+            self._rep_args = (jnp.asarray(is_rep), jnp.asarray(rep_rank),
+                              jnp.asarray(bases))
+        return self
+
+    def _getitem_spmd(self, ids):
+        ids = jnp.asarray(ids, jnp.int32)
+        hosts = self.info.hosts
+        if ids.shape[0] % hosts:
+            raise ValueError(
+                f"SPMD lookup ids length {ids.shape[0]} must be a "
+                f"multiple of the host count {hosts} (pad with -1)")
+        b = ids.shape[0] // hosts
+        dim = self._spmd_feat.shape[1]
+        key = (b, dim, self._spmd_feat.dtype, self._rep_args is not None)
+        fn = self._lookup_fns.get(key)
+        if fn is None:
+            from .comm import build_dist_lookup_fn
+            fn = build_dist_lookup_fn(
+                self.comm.mesh, self.comm.axis, self._rows_per_host, b,
+                dim, self._spmd_feat.dtype,
+                with_replicate=self._rep_args is not None)
+            self._lookup_fns[key] = fn
+        args = (ids, self.info.global2host.astype(jnp.int32),
+                self.info.global2local, self._spmd_feat)
+        if self._rep_args is not None:
+            args += self._rep_args
+        return fn(*args)
 
     def __getitem__(self, ids):
+        if self._spmd_feat is not None:
+            return self._getitem_spmd(ids)
         host_ids, host_pos = self.info.dispatch(ids)
         my = self.info.host
         n = int(np.asarray(jax.device_get(jnp.asarray(ids))).shape[0])
