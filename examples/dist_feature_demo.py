@@ -1,4 +1,4 @@
-"""Multi-host partitioned features: partition -> dispatch -> all_to_all.
+"""Multi-host partitioned features through the public DistFeature API.
 
 Demonstrates the DistFeature scaling story (reference multi-node path:
 PartitionInfo/DistFeature + NcclComm exchange, feature.py:461-567 +
@@ -6,9 +6,9 @@ comm.py:127-182) on a virtual 8-host mesh — the same program runs
 unchanged on a real multi-host TPU pod where the mesh axis rides ICI/DCN.
 
 Every "host" holds a shard of the feature rows (probability-partitioned);
-each host requests the rows its sampled frontier needs; one jitted
-all_to_all pair ships requests and responses. Verified against the
-unpartitioned ground truth.
+each host samples a frontier and looks its rows up with
+``dist[ids]`` — the fused SPMD program (dispatch + all_to_all exchange +
+scatter, one jit). Verified against the unpartitioned ground truth.
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      JAX_PLATFORMS=cpu python examples/dist_feature_demo.py
@@ -25,9 +25,13 @@ import numpy as np
 
 def main():
     import jax
+    # the axon TPU bootstrap force-registers the TPU platform regardless
+    # of env vars; the config knob wins over it (same dance as tests/)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from quiver_tpu import CSRTopo, PartitionInfo, TpuComm
+    from jax.sharding import Mesh
+    from quiver_tpu import CSRTopo, DistFeature, PartitionInfo, TpuComm
     from quiver_tpu.ops import sample_multihop, sample_prob
     from quiver_tpu.partition import partition_feature_without_replication
 
@@ -55,25 +59,16 @@ def main():
     global2host = np.zeros(n, np.int32)
     for h, part in enumerate(parts):
         global2host[np.asarray(part)] = h
-    info = [PartitionInfo(host=h, hosts=hosts, global2host=global2host)
-            for h in range(hosts)]
+    info = PartitionInfo(host=0, hosts=hosts, global2host=global2host)
 
-    # ---- per-host local stores, row-sharded over the mesh ------------------
-    rows_per_host = max(info[0].local_sizes)
-    store = np.zeros((hosts, rows_per_host, dim), np.float32)
-    g2l = np.asarray(info[0].global2local)
-    for g in range(n):
-        store[global2host[g], g2l[g]] = feat[g]
-    feat_sharded = jax.device_put(
-        store.reshape(hosts * rows_per_host, dim),
-        NamedSharding(mesh, P("host")))
-
-    # ---- each host samples a frontier and requests its rows ----------------
+    # ---- the public API: from_partition builds the mesh-sharded store ------
     comm = TpuComm(rank=0, world_size=hosts, mesh=mesh, axis="host")
-    cap = 4096
+    dist = DistFeature.from_partition(feat, info, comm)
+
+    # ---- each "host" samples a frontier; one fused lookup serves them all --
+    cap = 8192                       # per-host frontier budget (-1 padded)
     key = jax.random.key(0)
-    req = np.full((hosts, hosts, cap), -1, np.int32)
-    wanted = []                       # per host: (global ids, owner, pos)
+    batch_ids = np.full((hosts, cap), -1, np.int32)
     for h in range(hosts):
         seeds = jnp.asarray(rng.choice(n, 256, replace=False), jnp.int32)
         n_id, _ = sample_multihop(jnp.asarray(topo.indptr),
@@ -81,33 +76,29 @@ def main():
                                   jax.random.fold_in(key, h))
         ids = np.asarray(n_id)
         ids = ids[ids >= 0]
-        host_ids, host_pos = info[h].dispatch(ids)
-        for d in range(hosts):
-            take = min(host_ids[d].size, cap)
-            req[h, d, :take] = host_ids[d][:take]
-        wanted.append((ids, host_ids, host_pos))
+        batch_ids[h, :min(ids.size, cap)] = ids[:cap]
+    flat_ids = jnp.asarray(batch_ids.reshape(-1))
 
-    # warmup (compile), then timed run
-    jax.block_until_ready(
-        comm.exchange_spmd(jnp.asarray(req), feat_sharded, cap))
+    # warmup (compile), then timed run of dist[ids] — dispatch + exchange
+    # + scatter as ONE jitted SPMD program
+    jax.block_until_ready(dist[flat_ids])
     t0 = time.time()
-    resp = comm.exchange_spmd(jnp.asarray(req), feat_sharded, cap)
-    resp = np.asarray(jax.block_until_ready(resp))
+    out = np.asarray(jax.block_until_ready(dist[flat_ids]))
     dt = time.time() - t0
 
     # ---- verify against ground truth --------------------------------------
+    out = out.reshape(hosts, cap, dim)
     checked = 0
     for h in range(hosts):
-        ids, host_ids, host_pos = wanted[h]
-        for d in range(hosts):
-            take = min(host_ids[d].size, cap)
-            got = resp[h, d, :take]
-            want = feat[ids[host_pos[d][:take]]]
-            np.testing.assert_allclose(got, want, rtol=1e-6)
-            checked += take
+        valid = batch_ids[h] >= 0
+        np.testing.assert_allclose(out[h][valid],
+                                   feat[batch_ids[h][valid]], rtol=1e-6)
+        assert (out[h][~valid] == 0).all()
+        checked += int(valid.sum())
     total_bytes = checked * dim * 4
-    print(f"exchanged {checked} rows across {hosts} hosts in {dt * 1e3:.1f} ms"
-          f" ({total_bytes / dt / 1e9:.2f} GB/s) — all verified")
+    print(f"looked up {checked} rows across {hosts} hosts in "
+          f"{dt * 1e3:.1f} ms ({total_bytes / dt / 1e9:.2f} GB/s) — "
+          "all verified, padding returned zeros")
 
 
 if __name__ == "__main__":

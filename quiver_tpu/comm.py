@@ -155,8 +155,13 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
             h_count, dtype=owner.dtype)[:, None]                # [H, B]
         bucket_pos = jnp.cumsum(onehot, axis=1) - 1             # [H, B]
         my_pos = jnp.sum(jnp.where(onehot, bucket_pos, 0), axis=0)  # [B]
+        # invalid (-1 fill) entries must route to a POSITIVELY
+        # out-of-bounds row: `.at[...].set(mode="drop")` resolves negative
+        # indices NumPy-style BEFORE the bounds check, so owner=-1 would
+        # silently overwrite host H-1's bucket slot 0
+        owner_idx = jnp.where(valid, owner, h_count)
         req = jnp.zeros((h_count, batch_per_host), jnp.int32).at[
-            owner, my_pos].set(local, mode="drop")   # owner=-1 -> dropped
+            owner_idx, my_pos].set(local, mode="drop")
         incoming = jax.lax.all_to_all(
             req, axis, split_axis=0, concat_axis=0)             # [H, B]
         rows = feat[jnp.clip(incoming, 0, rows_per_host - 1)]   # [H, B, d]
