@@ -7,11 +7,14 @@ wrapper (shard_tensor.py:75-210):
 - a shard lives either in device HBM (``device >= 0``) or host memory
   (``device == -1``), with contiguous logical row ranges and offset
   bookkeeping, exactly like the reference's append model.
-- gather: device shards are gathered on-device (XLA gather / Pallas kernel
-  via ``quiver_tpu.ops.pallas.gather``); host shards are gathered on host
-  and overlapped onto the device result. The reference's P2P-peer-load
-  case disappears: chips in a slice share the array through GSPMD sharding
-  instead (see ``quiver_tpu.feature.Feature``).
+- storage is ONE contiguous array per placement group (grown at append
+  time — appends are few: one per device plus host), so a lookup is one
+  bucketed XLA gather per device group — ``searchsorted`` over the shard
+  offsets maps ids to in-group positions — instead of a per-shard
+  full-width select. Host rows are gathered on host and scattered onto
+  the device result. Invalid ids (< 0 or >= len) return zero rows. The
+  reference's P2P-peer-load case disappears: chips in a slice share the
+  array through GSPMD sharding instead (see ``quiver_tpu.feature.Feature``).
 - any float dtype works (the reference hardcodes float32, element size 4 —
   quiver_feature.cu:65-74; bf16 features are a free TPU win).
 """
@@ -43,12 +46,14 @@ class ShardTensorConfig:
 
 
 class _Shard:
-    __slots__ = ("data", "device", "rows")
+    """Logical shard: placement + row span inside its group's storage."""
 
-    def __init__(self, data, device: int, rows: int):
-        self.data = data
+    __slots__ = ("device", "rows", "base")
+
+    def __init__(self, device: int, rows: int, base: int):
         self.device = device
         self.rows = rows
+        self.base = base
 
 
 class ShardTensor:
@@ -60,6 +65,9 @@ class ShardTensor:
         self._offsets = [0]
         self._dim = None
         self._dtype = None
+        self._dev_data: Dict[int, jax.Array] = {}   # device -> group storage
+        self._host_data: Optional[np.ndarray] = None
+        self._index = None             # small lookup arrays, rebuilt on append
 
     # -- construction -------------------------------------------------------
     def append(self, tensor, device: int):
@@ -74,38 +82,90 @@ class ShardTensor:
             self._dtype = arr.dtype
         elif int(arr.shape[1]) != self._dim:
             raise ValueError("inconsistent feature dim")
+        elif arr.dtype != self._dtype:
+            # group storage is one contiguous array; a mixed-dtype append
+            # would silently promote (and possibly double) the whole store
+            raise ValueError(
+                f"inconsistent dtype: store is {self._dtype}, "
+                f"append is {arr.dtype}")
         if device >= 0:
             devs = jax.devices()
-            arr = jax.device_put(arr, devs[device % len(devs)])
-        self._shards.append(_Shard(arr, device, int(arr.shape[0])))
+            key = device % len(devs)
+            arr = jax.device_put(arr, devs[key])
+            prev = self._dev_data.get(key)
+            base = 0 if prev is None else int(prev.shape[0])
+            self._dev_data[key] = arr if prev is None else \
+                jnp.concatenate([prev, arr])
+            self._shards.append(_Shard(key, int(arr.shape[0]), base))
+        else:
+            base = 0 if self._host_data is None else \
+                int(self._host_data.shape[0])
+            self._host_data = np.asarray(arr) if self._host_data is None \
+                else np.concatenate([self._host_data, np.asarray(arr)])
+            self._shards.append(_Shard(-1, int(arr.shape[0]), base))
         self._offsets.append(self._offsets[-1] + int(arr.shape[0]))
+        self._index = None
+
+    def _build_index(self):
+        """Small per-shard lookup arrays for the id -> (group, position)
+        bucketing. O(#shards); rebuilt after appends."""
+        groups = np.asarray([s.device for s in self._shards], np.int64)
+        bases = np.asarray([s.base for s in self._shards], np.int64)
+        offsets = np.asarray(self._offsets, np.int64)
+        self._index = {
+            "offsets": offsets,
+            "group": groups,
+            "base": bases,
+            "inner_j": jnp.asarray(offsets[1:-1], jnp.int32),
+            "offsets_j": jnp.asarray(offsets[:-1], jnp.int32),
+            "group_j": jnp.asarray(groups, jnp.int32),
+            "base_j": jnp.asarray(bases, jnp.int32),
+        }
 
     # -- gather -------------------------------------------------------------
     def __getitem__(self, ids):
         if not self._shards:
             raise ValueError("empty ShardTensor")
-        ids_j = jnp.asarray(ids)
+        if self._index is None:
+            self._build_index()
+        ix = self._index
+        ids_j = jnp.asarray(ids).astype(jnp.int32)
         n = ids_j.shape[0]
-        out = jnp.zeros((n, self._dim), dtype=self._dtype)
-        host_shards = [s for s in self._shards if s.device < 0]
-        ids_np = None
-        if host_shards:
-            ids_np = np.asarray(jax.device_get(ids_j))
-        for shard, lo in zip(self._shards, self._offsets):
-            hi = lo + shard.rows
-            if shard.device >= 0:
-                mask = (ids_j >= lo) & (ids_j < hi)
-                local = jnp.clip(ids_j - lo, 0, shard.rows - 1)
-                got = jnp.take(shard.data, local, axis=0)
-                out = jnp.where(mask[:, None], got, out)
-            else:
-                mask_np = (ids_np >= lo) & (ids_np < hi)
-                pos = np.flatnonzero(mask_np)
-                if pos.size == 0:
-                    continue
-                local = ids_np[pos] - lo
-                got = jax.device_put(shard.data[local])
-                out = out.at[jnp.asarray(pos)].set(got)
+        total = self._offsets[-1]
+        valid = (ids_j >= 0) & (ids_j < total)
+        # bucket: which shard owns each id, and its position inside that
+        # shard's group storage
+        shard_idx = jnp.searchsorted(
+            ix["inner_j"], jnp.clip(ids_j, 0, total - 1),
+            side="right").astype(jnp.int32)
+        group = jnp.where(valid, ix["group_j"][shard_idx], -2)
+        local = (jnp.clip(ids_j, 0, total - 1) - ix["offsets_j"][shard_idx]
+                 + ix["base_j"][shard_idx])
+        out = None
+        n_sources = len(self._dev_data) + (self._host_data is not None)
+        for key, data in self._dev_data.items():
+            rows = data.shape[0]
+            hit = group == key
+            got = jnp.take(data, jnp.clip(local, 0, rows - 1), axis=0)
+            if n_sources == 1:
+                # single storage group: one gather, one masked select
+                return jnp.where(hit[:, None], got, 0)
+            out = jnp.where(hit[:, None], got, 0 if out is None else out)
+        if out is None:
+            out = jnp.zeros((n, self._dim), dtype=self._dtype)
+        if self._host_data is not None:
+            ids_np = np.asarray(jax.device_get(ids_j)).astype(np.int64)
+            ok = (ids_np >= 0) & (ids_np < total)
+            shard_np = np.searchsorted(ix["offsets"][1:-1],
+                                       np.clip(ids_np, 0, total - 1),
+                                       side="right")
+            host_pos = np.flatnonzero(ok & (ix["group"][shard_np] < 0))
+            if host_pos.size:
+                local_np = (ids_np[host_pos]
+                            - ix["offsets"][shard_np[host_pos]]
+                            + ix["base"][shard_np[host_pos]])
+                got = jax.device_put(self._host_data[local_np])
+                out = out.at[jnp.asarray(host_pos)].set(got)
         return out
 
     # -- shape protocol ------------------------------------------------------
@@ -116,18 +176,24 @@ class ShardTensor:
     def size(self, dim: int) -> int:
         return self.shape[dim]
 
+    def _shard_data(self, s: _Shard):
+        store = self._host_data if s.device < 0 else self._dev_data[s.device]
+        return store[s.base:s.base + s.rows]
+
     @property
     def device_tensor_list(self):
-        return [s.data for s in self._shards if s.device >= 0]
+        return [self._shard_data(s) for s in self._shards if s.device >= 0]
 
     @property
     def cpu_tensor(self):
-        parts = [s.data for s in self._shards if s.device < 0]
-        return np.concatenate(parts) if parts else None
+        # a copy, matching the old concatenate-built return: callers may
+        # mutate it without corrupting the backing store
+        return None if self._host_data is None else self._host_data.copy()
 
     # -- cross-process compat (single process owns all chips on TPU) --------
     def share_ipc(self):
-        return [(s.data, s.device, s.rows) for s in self._shards]
+        return [(self._shard_data(s), s.device, s.rows)
+                for s in self._shards]
 
     @classmethod
     def new_from_share_ipc(cls, items, current_device: int = 0):

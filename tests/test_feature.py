@@ -41,6 +41,78 @@ class TestShardTensor:
         out = st[jnp.arange(10)]
         assert out.dtype == jnp.bfloat16
 
+    def test_many_shards_bucketed_gather(self, rng):
+        # 12 shards, mixed device/host, uneven sizes — the merge must be
+        # a bucketed gather (one per placement group), not a per-shard
+        # full-width select, and must still be exact
+        sizes = [7, 13, 1, 20, 5, 9, 2, 17, 3, 11, 4, 8]
+        data = rng.standard_normal((sum(sizes), 6)).astype(np.float32)
+        st = qv.ShardTensor(0)
+        lo = 0
+        for i, s in enumerate(sizes):
+            st.append(data[lo:lo + s], 0 if i % 3 else -1)
+            lo += s
+        ids = rng.integers(0, sum(sizes), 200)
+        np.testing.assert_allclose(
+            np.asarray(st[jnp.asarray(ids)]), data[ids], rtol=1e-6)
+        assert st.shape == (sum(sizes), 6)
+
+    def test_shard_boundaries_exact(self, rng):
+        # ids exactly at every shard boundary (first/last row of each)
+        sizes = [4, 4, 4, 4, 4, 4, 4, 4]
+        data = rng.standard_normal((32, 3)).astype(np.float32)
+        st = qv.ShardTensor(0)
+        lo = 0
+        for i, s in enumerate(sizes):
+            st.append(data[lo:lo + s], 0 if i % 2 else -1)
+            lo += s
+        edges = np.array(sorted({0, 31} | {sum(sizes[:i]) for i in
+                                           range(1, 8)}
+                                | {sum(sizes[:i]) - 1 for i in range(1, 9)}))
+        np.testing.assert_allclose(
+            np.asarray(st[jnp.asarray(edges)]), data[edges], rtol=1e-6)
+
+    def test_invalid_ids_return_zeros(self, rng):
+        # -1 fill (sampler frontiers) and past-the-end ids must come back
+        # as zero rows — on the pure-device path, the host path, and mixed
+        data = rng.standard_normal((20, 4)).astype(np.float32)
+        cases = [[(data, 0)],                       # device only
+                 [(data, -1)],                      # host only
+                 [(data[:10], 0), (data[10:], -1)]]  # mixed
+        for blocks in cases:
+            st = qv.ShardTensor(0)
+            for block, dev in blocks:
+                st.append(block, dev)
+            ids = np.array([-1, 0, 19, 20, 500, -7, 10])
+            got = np.asarray(st[jnp.asarray(ids)])
+            ok = (ids >= 0) & (ids < 20)
+            np.testing.assert_allclose(got[ok], data[ids[ok]], rtol=1e-6)
+            assert (got[~ok] == 0).all(), blocks
+
+    def test_no_storage_duplication(self, rng):
+        # appends grow ONE array per placement group; lookups must not
+        # allocate a second full copy of the store
+        data = rng.standard_normal((40, 4)).astype(np.float32)
+        st = qv.ShardTensor(0)
+        for lo in range(0, 40, 10):
+            st.append(data[lo:lo + 10], 0)
+        _ = st[jnp.arange(5)]
+        assert len(st._dev_data) == 1
+        assert st._dev_data[0].shape == (40, 4)
+        assert st.cpu_tensor is None
+
+    def test_append_after_gather(self, rng):
+        # the lazy group cache must invalidate on append
+        data = rng.standard_normal((30, 4)).astype(np.float32)
+        st = qv.ShardTensor(0)
+        st.append(data[:10], 0)
+        np.testing.assert_allclose(
+            np.asarray(st[jnp.arange(10)]), data[:10], rtol=1e-6)
+        st.append(data[10:], -1)
+        ids = rng.integers(0, 30, 25)
+        np.testing.assert_allclose(
+            np.asarray(st[jnp.asarray(ids)]), data[ids], rtol=1e-6)
+
     def test_ipc_roundtrip(self, rng):
         data = rng.standard_normal((20, 4)).astype(np.float32)
         st = qv.ShardTensor(0)
