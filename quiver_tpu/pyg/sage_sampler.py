@@ -357,6 +357,9 @@ class MixedGraphSageSampler:
     native call and one process owns the TPU.
     """
 
+    #: EMA smoothing for per-task time estimates (higher = faster adapt)
+    EMA_ALPHA = 0.25
+
     def __init__(self, sample_job: SampleJob, sizes: Sequence[int],
                  csr_topo: CSRTopo, device=None,
                  device_mode: str = "HBM", num_workers: int = 2, seed: int = 0):
@@ -368,14 +371,20 @@ class MixedGraphSageSampler:
         self.cpu_sampler = GraphSageSampler(
             csr_topo, sizes, mode="CPU", seed=seed + 1)
         self._pool = None
-        self._device_time = None
-        self._cpu_time = None
+        self._device_time = None       # EMA seconds per device task
+        self._cpu_time = None          # EMA seconds per host task
+        import threading
+        self._time_lock = threading.Lock()   # _cpu_one runs on pool threads
 
     def _ensure_pool(self):
         if self._pool is None:
             import concurrent.futures
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=self.num_workers)
+
+    def _ema(self, old, dt):
+        a = self.EMA_ALPHA
+        return dt if old is None else a * dt + (1.0 - a) * old
 
     def decide_task_num(self):
         device_tasks = max(20, 2 * self.num_workers)
@@ -390,19 +399,34 @@ class MixedGraphSageSampler:
     def __iter__(self):
         self.job.shuffle()
         self._ensure_pool()
+        import concurrent.futures as cf
         n = len(self.job)
         idx = 0
-        pending = []
+        pending: List = []
+
+        def drain_done():
+            nonlocal pending
+            done = [f for f in pending if f.done()]
+            pending = [f for f in pending if not f.done()]
+            return done
+
         while idx < n or pending:
             device_quota, cpu_quota = self.decide_task_num()
-            # dispatch host tasks first (they run in the background)
-            while idx < n and cpu_quota > 0:
+            # dispatch host tasks first (they run in the background);
+            # never queue beyond the pool width — tasks queued past it are
+            # pure backlog, and during bootstrap (no host measurement yet)
+            # an unbounded queue would commit dozens of batches to a host
+            # pool that may turn out to be 1000x slower than the device
+            while (idx < n and cpu_quota > 0
+                   and len(pending) < self.num_workers):
                 seeds = self.job[idx]
                 idx += 1
                 cpu_quota -= 1
                 pending.append(self._pool.submit(
                     self._cpu_one, np.asarray(seeds)))
-            # run device tasks inline
+            # run device tasks inline, yielding finished host tasks
+            # between them (non-blocking — the reference's round barrier
+            # would stall the device on the slowest host task)
             for _ in range(device_quota):
                 if idx >= n:
                     break
@@ -411,16 +435,28 @@ class MixedGraphSageSampler:
                 t0 = time.perf_counter()
                 out = self.device_sampler.sample(seeds)
                 jax.block_until_ready(out[0])
-                self._device_time = time.perf_counter() - t0
+                self._device_time = self._ema(
+                    self._device_time, time.perf_counter() - t0)
                 yield out
-            for fut in pending:
+                for fut in drain_done():
+                    yield fut.result()
+            for fut in drain_done():
                 yield fut.result()
-            pending = []
+            if idx >= n and pending:
+                # everything dispatched: now blocking is idle-waiting,
+                # not stalling — take tasks as they finish
+                done, rest = cf.wait(pending,
+                                     return_when=cf.FIRST_COMPLETED)
+                pending = list(rest)
+                for fut in done:
+                    yield fut.result()
 
     def _cpu_one(self, seeds):
         t0 = time.perf_counter()
         out = self.cpu_sampler.sample(seeds)
-        self._cpu_time = time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        with self._time_lock:          # concurrent pool threads
+            self._cpu_time = self._ema(self._cpu_time, dt)
         return out
 
     def share_ipc(self):

@@ -244,6 +244,66 @@ class TestMixedSampler:
             assert bs == 16
             assert len(adjs) == 2
 
+    def test_adapts_quota_to_skewed_speeds(self, topo):
+        # skew the measured per-task times and assert the host quota
+        # shifts the right way: slow host -> fewer host tasks, fast
+        # host -> more
+        job = _ArrayJob(np.arange(topo.node_count)[:96], 16)
+        mixed = qv.MixedGraphSageSampler(job, [3, 2], topo, num_workers=2)
+        dev_quota0, cpu_quota0 = mixed.decide_task_num()  # bootstrap
+        mixed._device_time = 0.001
+        mixed._cpu_time = 0.5            # host 500x slower
+        _, cpu_slow = mixed.decide_task_num()
+        mixed._cpu_time = 0.001
+        mixed._device_time = 0.5         # device 500x slower
+        _, cpu_fast = mixed.decide_task_num()
+        assert cpu_slow < cpu_quota0 <= cpu_fast
+        assert cpu_slow == 0
+
+    def test_ema_smooths_timing(self, topo):
+        job = _ArrayJob(np.arange(topo.node_count)[:32], 16)
+        mixed = qv.MixedGraphSageSampler(job, [3, 2], topo)
+        assert mixed._ema(None, 4.0) == 4.0
+        t = mixed._ema(4.0, 0.0)
+        assert 0.0 < t < 4.0             # one outlier can't reset the EMA
+        # repeated fast samples converge toward the new value
+        for _ in range(30):
+            t = mixed._ema(t, 0.0)
+        assert t < 0.01
+
+    def test_mixed_interleaves_without_round_barrier(self, topo):
+        # a host task slower than a whole device round must not block
+        # device yields. Stub both samplers (instant device, 0.6s host)
+        # so the schedule is deterministic: with the non-blocking drain,
+        # round 2's device results flow while the host future is still
+        # sleeping; the old per-round barrier would have parked the
+        # iterator at the round boundary until the host task finished.
+        import time as _time
+        job = _ArrayJob(np.arange(120), 4)      # 30 tasks, 20/dev round
+        mixed = qv.MixedGraphSageSampler(job, [3, 2], topo, num_workers=1)
+
+        class _DevStub:
+            def sample(self, seeds):
+                return jnp.zeros(1), "dev", []
+
+        mixed.device_sampler = _DevStub()
+        mixed._cpu_one = lambda seeds: (_time.sleep(0.6)
+                                        or (jnp.zeros(1), "cpu", []))
+        t0 = _time.perf_counter()
+        kinds, stamps = [], []
+        for out in mixed:
+            kinds.append(out[1])
+            stamps.append(_time.perf_counter() - t0)
+        assert len(kinds) == len(job)
+        assert kinds.count("cpu") >= 1
+        first_cpu = kinds.index("cpu")
+        # device yields crossed the round boundary (>20 of them) before
+        # the 0.6s host task was drained...
+        assert first_cpu > 20
+        # ...and they did so while the host task was still sleeping —
+        # i.e. no round barrier ate the 0.6s
+        assert stamps[20] < 0.5
+
     def test_sample_prob_propagates(self, topo):
         sampler = qv.GraphSageSampler(topo, sizes=[3, 2])
         prob = np.asarray(sampler.sample_prob(
