@@ -1,10 +1,14 @@
-"""Per-stage timing of the multihop sampler on the real chip.
+"""Per-stage timing of the production sampling path on the real chip.
 
-Times each hop's sample_layer and compact_layer separately (each as one
-on-device scan of ITERS reps) to locate the bottleneck. Not part of the
-metric of record; a development tool.
+Times each stage of the bench epoch (bench.py run_epoch) separately —
+the per-epoch permute_csr row shuffle, and per hop the rotation sampler
+and the sort-based compaction — each as one on-device scan of ITERS
+reps, to locate the bottleneck. `--exact` profiles the Fisher–Yates
+sampler instead of rotation. Not part of the metric of record; a
+development tool.
 """
 
+import argparse
 import os
 import sys
 import time
@@ -19,7 +23,9 @@ jax.config.update("jax_compilation_cache_dir",
                                "..", ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-from quiver_tpu.ops.sample import sample_layer, compact_layer
+from quiver_tpu.ops.sample import (as_index_rows, compact_layer,
+                                   edge_row_ids, permute_csr, sample_layer,
+                                   sample_layer_rotation)
 
 N = 2_450_000
 AVG = 25
@@ -36,6 +42,12 @@ def timed(fn, *args):
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--exact", action="store_true",
+                    help="profile the exact Fisher-Yates sampler")
+    ap.add_argument("--iters", type=int, default=ITERS)
+    args = ap.parse_args()
+    iters = args.iters
     key = jax.random.key(0)
 
     @jax.jit
@@ -52,24 +64,46 @@ def main():
         lambda k: jax.random.randint(k, (e,), 0, N, dtype=jnp.int32)
     )(jax.random.fold_in(key, 1))
     jax.block_until_ready(indices)
+    row_ids = jax.jit(edge_row_ids, static_argnums=1)(indptr, e)
+    jax.block_until_ready(row_ids)
+    print(f"graph: {N} nodes, {e} edges")
 
-    # frontier sizes per hop (static caps)
+    # ---- per-epoch stage: the row shuffle (once per epoch, not per batch)
+    def perm(indices, row_ids, k):
+        return permute_csr(indices, row_ids, k)
+
+    dt_p, permuted = timed(jax.jit(perm), indices, row_ids,
+                           jax.random.fold_in(key, 2))
+    rows = jax.block_until_ready(jax.jit(as_index_rows)(permuted))
+    print(f"permute_csr (1x/epoch):          {dt_p * 1e3:8.2f} ms")
+
+    # ---- per-batch stages, at each hop's frontier size
     fronts = [BATCH]
     for k in SIZES:
         fronts.append(fronts[-1] * (1 + k))
-    print("frontier caps:", fronts)
+    print("frontier caps:", fronts[:-1])
 
     for li, k in enumerate(SIZES):
         s = fronts[li]
 
-        def samp(indptr, indices, kk, s=s, k=k):
+        def samp_rot(indptr, rows, kk, s=s, k=k):
+            def body(c, i):
+                kb = jax.random.fold_in(kk, i)
+                seeds = jax.random.randint(kb, (s,), 0, N, dtype=jnp.int32)
+                nbrs, cnt = sample_layer_rotation(indptr, rows, seeds, k, kb)
+                return c + jnp.sum(cnt), None
+            tot, _ = jax.lax.scan(body, jnp.int32(0),
+                                  jnp.arange(iters, dtype=jnp.int32))
+            return tot
+
+        def samp_exact(indptr, indices, kk, s=s, k=k):
             def body(c, i):
                 kb = jax.random.fold_in(kk, i)
                 seeds = jax.random.randint(kb, (s,), 0, N, dtype=jnp.int32)
                 nbrs, cnt = sample_layer(indptr, indices, seeds, k, kb)
                 return c + jnp.sum(cnt), None
             tot, _ = jax.lax.scan(body, jnp.int32(0),
-                                  jnp.arange(ITERS, dtype=jnp.int32))
+                                  jnp.arange(iters, dtype=jnp.int32))
             return tot
 
         def comp(kk, s=s, k=k):
@@ -82,15 +116,19 @@ def main():
                 lay = compact_layer(seeds, nbrs)
                 return c + lay.n_count, None
             tot, _ = jax.lax.scan(body, jnp.int32(0),
-                                  jnp.arange(ITERS, dtype=jnp.int32))
+                                  jnp.arange(iters, dtype=jnp.int32))
             return tot
 
-        dt_s, _ = timed(jax.jit(samp), indptr, indices,
-                        jax.random.fold_in(key, 10 + li))
+        if args.exact:
+            dt_s, _ = timed(jax.jit(samp_exact), indptr, indices,
+                            jax.random.fold_in(key, 10 + li))
+        else:
+            dt_s, _ = timed(jax.jit(samp_rot), indptr, rows,
+                            jax.random.fold_in(key, 10 + li))
         dt_c, _ = timed(jax.jit(comp), jax.random.fold_in(key, 20 + li))
         print(f"hop {li} (s={s:>7}, k={k:>2}): "
-              f"sample {dt_s / ITERS * 1e3:8.2f} ms   "
-              f"compact {dt_c / ITERS * 1e3:8.2f} ms")
+              f"sample {dt_s / iters * 1e3:8.2f} ms   "
+              f"compact {dt_c / iters * 1e3:8.2f} ms")
 
 
 if __name__ == "__main__":
