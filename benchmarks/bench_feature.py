@@ -1,11 +1,23 @@
 """Feature-collection benchmark: effective gather GB/s.
 
 Mirrors the reference benchmark (benchmarks/feature/bench_feature.py,
-GB/s metric at :44-46): random-id row gather from a products-shaped
-feature array (N x 100 float32), XLA take vs the Pallas gather kernel.
+GB/s metric at :44-46; published UVA number: 14.82 GB/s,
+docs/Introduction_en.md:92-97): random-id row gather from a
+products-shaped feature array (N x 100 float32).
+
+Modes:
+  (default)    raw device gather: XLA take from HBM
+  --pallas     the Pallas DMA gather kernel instead of XLA take
+  --tiered F   the real ``quiver_tpu.Feature`` store with fraction F of
+               rows HBM-cached (0, 0.2, 1.0 = the VERDICT grid) and the
+               rest in the host tier
+  --prefetch   with --tiered: pipeline lookups via feature.prefetch()
+               (stage batch i+1's host rows while batch i transfers) —
+               the double-buffered path a training loop uses
 
 Usage: python benchmarks/bench_feature.py [--rows N] [--dim D]
        [--batch B] [--iters K] [--pallas] [--bf16]
+       [--tiered F] [--prefetch]
 """
 
 import argparse
@@ -14,6 +26,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
 
 
 def main():
@@ -25,42 +39,88 @@ def main():
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--pallas", action="store_true")
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--tiered", type=float, default=None, metavar="FRAC",
+                   help="bench the tiered Feature store with FRAC of "
+                        "rows cached in HBM (rest in the host tier)")
+    p.add_argument("--prefetch", action="store_true",
+                   help="with --tiered: double-buffer via prefetch()")
     args = p.parse_args()
 
     import jax
+    # the axon TPU bootstrap force-registers the TPU platform; the config
+    # knob wins over it so JAX_PLATFORMS=cpu is honored
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
-    from quiver_tpu.ops.pallas.gather import gather_rows
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     key = jax.random.key(0)
-    feat = jax.jit(
-        lambda k: jax.random.normal(k, (args.rows, args.dim), dtype=dtype)
-    )(jax.random.fold_in(key, 1))
 
     @jax.jit
     def make_ids(k):
         return jax.random.randint(k, (args.batch,), 0, args.rows,
                                   dtype=jnp.int32)
 
-    if args.pallas:
-        run = lambda ids: gather_rows(feat, ids)
+    if args.tiered is not None:
+        import quiver_tpu as qv
+        frac = args.tiered
+        rng = np.random.default_rng(0)
+        feat_np = rng.standard_normal(
+            (args.rows, args.dim)).astype(np.float32)
+        if args.bf16:
+            feat_np = feat_np.astype(jnp.bfloat16)
+        row_bytes = args.dim * feat_np.dtype.itemsize
+        f = qv.Feature(device_cache_size=int(args.rows * frac) * row_bytes)
+        f.from_cpu_tensor(feat_np)
+        label = (f"tiered cache={frac:.0%}"
+                 + (" prefetch" if args.prefetch else " sync"))
+        ids = [make_ids(jax.random.fold_in(key, 10 + i))
+               for i in range(args.iters)]
+        # warmup (compile both tiers' programs)
+        jax.block_until_ready(f[ids[0]])
+
+        t0 = time.perf_counter()
+        if args.prefetch:
+            fut = f.prefetch(ids[0])
+            for i in range(args.iters):
+                out = fut.result()
+                if i + 1 < args.iters:
+                    fut = f.prefetch(ids[i + 1])
+                # consume the batch on-device (stand-in for the model
+                # step the staging overlaps with)
+                s = jnp.sum(out)
+            jax.block_until_ready(s)
+        else:
+            for i in range(args.iters):
+                s = jnp.sum(f[ids[i]])
+            jax.block_until_ready(s)
+        dt = time.perf_counter() - t0
     else:
-        run = jax.jit(lambda ids: jnp.take(feat, ids, axis=0))
+        from quiver_tpu.ops.pallas.gather import gather_rows
+        feat = jax.jit(
+            lambda k: jax.random.normal(k, (args.rows, args.dim),
+                                        dtype=dtype)
+        )(jax.random.fold_in(key, 1))
 
-    out = run(make_ids(jax.random.fold_in(key, 2)))
-    jax.block_until_ready(out)
+        if args.pallas:
+            run = lambda ids: gather_rows(feat, ids)
+        else:
+            run = jax.jit(lambda ids: jnp.take(feat, ids, axis=0))
 
-    t0 = time.perf_counter()
-    for i in range(args.iters):
-        out = run(make_ids(jax.random.fold_in(key, 10 + i)))
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
+        out = run(make_ids(jax.random.fold_in(key, 2)))
+        jax.block_until_ready(out)
+        label = "pallas" if args.pallas else "xla-take"
+
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            out = run(make_ids(jax.random.fold_in(key, 10 + i)))
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
 
     bytes_moved = args.iters * args.batch * args.dim * \
         jnp.dtype(dtype).itemsize
-    label = "pallas" if args.pallas else "xla-take"
-    print(f"[{label} {dtype}] {bytes_moved / 1e9:.2f} GB in {dt:.3f}s -> "
-          f"{bytes_moved / dt / 1e9:.2f} GB/s")
+    print(f"[{label} {jnp.dtype(dtype).name}] {bytes_moved / 1e9:.2f} GB "
+          f"in {dt:.3f}s -> {bytes_moved / dt / 1e9:.2f} GB/s")
 
 
 if __name__ == "__main__":

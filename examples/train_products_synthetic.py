@@ -134,21 +134,36 @@ def main():
         perm = rng.permutation(train_idx)
         t0 = time.perf_counter()
         epoch_loss, nb = 0.0, 0
-        for lo in range(0, len(perm) - bs + 1, bs):
-            seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
-            y = jnp.asarray(labels[perm[lo:lo + bs]])
-            if fully_cached:
+        starts = list(range(0, len(perm) - bs + 1, bs))
+        if fully_cached:
+            for lo in starts:
+                seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
+                y = jnp.asarray(labels[perm[lo:lo + bs]])
                 state, loss = step(state, feat_j, forder, indptr_j,
                                    indices_j, seeds, y, jax.random.key(it))
-            else:
-                n_id, adjs = sample_fn(indptr_j, indices_j, seeds,
-                                       jax.random.key(it))
-                x = feature[n_id]          # tiered gather (HBM + host)
-                state, loss = apply_fn(state, x, adjs, y,
+                it += 1
+                epoch_loss += float(loss)
+                nb += 1
+        elif starts:
+            # tiered path, double-buffered: sample batch i+1 and prefetch
+            # its feature rows (host-tier staging runs on a background
+            # thread) while batch i's model step computes
+            def stage(lo, k):
+                seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
+                n_id, adjs = sample_fn(indptr_j, indices_j, seeds, k)
+                return adjs, feature.prefetch(n_id), \
+                    jnp.asarray(labels[perm[lo:lo + bs]])
+
+            nxt = stage(starts[0], jax.random.key(it))
+            for bi, lo in enumerate(starts):
+                adjs, fut, y = nxt
+                if bi + 1 < len(starts):
+                    nxt = stage(starts[bi + 1], jax.random.key(it + 1))
+                state, loss = apply_fn(state, fut.result(), adjs, y,
                                        jax.random.key(1000000 + it))
-            it += 1
-            epoch_loss += float(loss)
-            nb += 1
+                it += 1
+                epoch_loss += float(loss)
+                nb += 1
         dt = time.perf_counter() - t0
         print(f"epoch {epoch}: loss {epoch_loss / max(nb, 1):.4f}  "
               f"{dt:.2f}s  ({nb * bs / dt:.0f} seeds/s)")

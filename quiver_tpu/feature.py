@@ -9,7 +9,13 @@ tiers (by bandwidth, mirroring HBM > NVLink > pinned host > disk):
                       row-sharded over the ICI mesh axis
                       (``p2p_clique_replicate`` — a whole TPU slice is one
                       "NVLink clique", so the clique generalizes to the mesh)
-  2. host memory    — remaining rows, gathered on host, overlapped in
+  2. host memory    — remaining rows, gathered on host. A plain
+                      ``feature[ids]`` is synchronous; ``prefetch(ids)``
+                      stages the host rows on a background thread so the
+                      next batch's staging overlaps the current batch's
+                      compute (the TPU analogue of the reference's UVA
+                      kernel reading pinned host memory during the gather,
+                      quiver_feature.cu:174-203)
   3. disk (mmap)    — optional numpy-memmap tier via ``disk_map``
                       (reference feature.py:84-93, 309-333)
 
@@ -86,6 +92,7 @@ class Feature:
         self.disk_map = None
         self._gather_cached = None
         self._translate = None
+        self._pool = None              # prefetch staging thread
 
     # -- sizing (reference feature.py:74-82) --------------------------------
     def cal_size(self, cpu_tensor, cache_memory_budget: int) -> int:
@@ -211,6 +218,22 @@ class Feature:
             out = jnp.zeros(shape, dtype=host_rows.dtype)
         return out.at[jnp.asarray(pos)].set(jax.device_put(host_rows))
 
+    def prefetch(self, node_idx):
+        """Start this lookup on a background thread and return a
+        ``concurrent.futures.Future`` whose ``result()`` equals
+        ``feature[node_idx]``. The expensive part of a tiered lookup is
+        host-side (cold-row fancy-index + transfer); running it off the
+        main thread lets batch i+1's staging overlap batch i's model
+        step — double-buffering, the TPU answer to the reference's UVA
+        gather overlapping transfer with compute
+        (quiver_feature.cu:174-293)."""
+        if self._pool is None:
+            import concurrent.futures
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2)
+        ids = jnp.asarray(node_idx)    # snapshot before caller moves on
+        return self._pool.submit(self.__getitem__, ids)
+
     def _read_cold(self, cold_ids: np.ndarray) -> np.ndarray:
         if self.mmap_array is not None and self.disk_map is not None:
             # disk_map is indexed by storage row (reference feature.py:84-93)
@@ -258,13 +281,14 @@ class Feature:
     # -- pickling: drop compiled closures, rebuild on load ------------------
     def __getstate__(self):
         state = {k: getattr(self, k) for k in self.__dict__
-                 if k not in ("_gather_cached", "_translate")}
+                 if k not in ("_gather_cached", "_translate", "_pool")}
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._gather_cached = None
         self._translate = None
+        self._pool = None
         self._build_gather()
 
     # -- process sharing compat ---------------------------------------------

@@ -196,6 +196,40 @@ class TestFeature:
         np.testing.assert_allclose(got[1], disk[5], rtol=1e-6)
         np.testing.assert_allclose(got[2], disk[9], rtol=1e-6)
 
+    def test_prefetch_matches_sync_lookup(self):
+        f, feat = make_feature(cache_frac=0.3)
+        ids = np.array([0, 29, 30, 99, 45, 2])
+        fut = f.prefetch(ids)
+        np.testing.assert_allclose(
+            np.asarray(fut.result()), feat[ids], rtol=1e-6)
+        # pipelined: several in flight, order preserved per-future
+        futs = [f.prefetch(np.array([i, 99 - i])) for i in range(5)]
+        for i, fu in enumerate(futs):
+            np.testing.assert_allclose(
+                np.asarray(fu.result()), feat[[i, 99 - i]], rtol=1e-6)
+
+    def test_prefetch_overlaps_host_staging(self):
+        # the future must come back immediately (staging runs on the
+        # pool thread), not after the host fancy-index completes
+        import time as _time
+        f, feat = make_feature(n=2000, dim=64, cache_frac=0.0)
+        real_read = f._read_cold
+
+        def slow_read(cold_ids):
+            _time.sleep(0.3)
+            return real_read(cold_ids)
+
+        f._read_cold = slow_read
+        t0 = _time.perf_counter()
+        fut = f.prefetch(np.arange(500))
+        submitted = _time.perf_counter() - t0
+        out = fut.result()
+        total = _time.perf_counter() - t0
+        assert submitted < 0.1       # caller wasn't blocked
+        assert total >= 0.3          # the staging really ran
+        np.testing.assert_allclose(np.asarray(out), feat[np.arange(500)],
+                                   rtol=1e-6)
+
     def test_size_dim_shape(self):
         f, _ = make_feature(n=100, dim=16, cache_frac=0.5)
         assert f.shape == (100, 16)
