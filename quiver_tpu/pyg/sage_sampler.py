@@ -412,21 +412,28 @@ class MixedGraphSageSampler:
 
         while idx < n or pending:
             device_quota, cpu_quota = self.decide_task_num()
-            # dispatch host tasks first (they run in the background);
-            # never queue beyond the pool width — tasks queued past it are
-            # pure backlog, and during bootstrap (no host measurement yet)
-            # an unbounded queue would commit dozens of batches to a host
-            # pool that may turn out to be 1000x slower than the device
-            while (idx < n and cpu_quota > 0
-                   and len(pending) < self.num_workers):
-                seeds = self.job[idx]
-                idx += 1
-                cpu_quota -= 1
-                pending.append(self._pool.submit(
-                    self._cpu_one, np.asarray(seeds)))
+
+            def dispatch_host():
+                # keep the pool fed up to its width, within this round's
+                # quota; never queue past the width — tasks queued beyond
+                # it are pure backlog, and during bootstrap (no host
+                # measurement yet) an unbounded queue would commit dozens
+                # of batches to a host pool that may turn out to be
+                # 1000x slower than the device
+                nonlocal idx, cpu_quota
+                while (idx < n and cpu_quota > 0
+                       and len(pending) < self.num_workers):
+                    seeds = self.job[idx]
+                    idx += 1
+                    cpu_quota -= 1
+                    pending.append(self._pool.submit(
+                        self._cpu_one, np.asarray(seeds)))
+
+            dispatch_host()
             # run device tasks inline, yielding finished host tasks
             # between them (non-blocking — the reference's round barrier
-            # would stall the device on the slowest host task)
+            # would stall the device on the slowest host task) and
+            # refilling the host pool as slots free up
             for _ in range(device_quota):
                 if idx >= n:
                     break
@@ -440,6 +447,7 @@ class MixedGraphSageSampler:
                 yield out
                 for fut in drain_done():
                     yield fut.result()
+                dispatch_host()
             for fut in drain_done():
                 yield fut.result()
             if idx >= n and pending:
