@@ -145,7 +145,11 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import jax.numpy as jnp
     from quiver_tpu.ops import (sample_multihop, permute_csr, edge_row_ids,
-                                as_index_rows)
+                                as_index_rows, as_index_rows_overlapping)
+    # rotation row layout: "overlap" = one gather/seed, 2x index memory;
+    # "pair" = two gathers/seed (compare on-chip with
+    # `python benchmarks/micro_ops.py --suite layout`)
+    layout = os.environ.get("QT_BENCH_LAYOUT", "pair")
 
     key = jax.random.key(0)
 
@@ -184,9 +188,14 @@ def main():
         @jax.jit
         def run_epoch(indptr, indices, row_ids, key):
             kperm, kseed, kbatch = jax.random.split(key, 3)
+            stride = None
             if method == "rotation":
                 permuted = permute_csr(indices, row_ids, kperm)
-                rows = as_index_rows(permuted)
+                if layout == "overlap":
+                    rows = as_index_rows_overlapping(permuted)
+                    stride = 128
+                else:
+                    rows = as_index_rows(permuted)
             else:
                 permuted, rows = indices, None
             # epoch batching the way training runs it: a fresh
@@ -202,7 +211,8 @@ def main():
                 _, layers = sample_multihop(indptr, permuted, seeds, sizes,
                                             jax.random.fold_in(kbatch, i),
                                             method=method,
-                                            indices_rows=rows)
+                                            indices_rows=rows,
+                                            indices_stride=stride)
                 edges = sum(l.edge_count.astype(jnp.int32) for l in layers)
                 return total + edges, None
             total, _ = jax.lax.scan(

@@ -1,0 +1,136 @@
+"""Microbenchmark CLI for the primitives the sampler is built from.
+
+One tool, several suites (replaces the former micro.py / micro2.py /
+micro3.py dev-scratch):
+
+  primitives  sort / gather / scan / cumsum costs at sampler sizes
+  gather      row-gather cost vs row width and index locality
+  layout      rotation row layouts head-to-head: pair (two 128-wide
+              gathers/seed) vs overlap (one 256-wide gather/seed, 2x
+              index memory) at every hop's frontier size — the numbers
+              behind bench.py's QT_BENCH_LAYOUT default
+
+Usage: python benchmarks/micro_ops.py [--suite primitives|gather|layout]
+       [--iters K]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+E = 61_000_000
+M = 1 << 20
+key = jax.random.key(0)
+
+
+def timed(label, fn, *args, iters=1):
+    out = jax.block_until_ready(fn(*args))           # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:<44} {dt * 1e3:9.3f} ms")
+    return dt
+
+
+def suite_primitives(iters):
+    x = jax.jit(lambda k: jax.random.bits(k, (M,)).astype(jnp.int32))(key)
+    big = jax.jit(lambda k: jax.random.bits(k, (E,)).astype(jnp.int32))(
+        jax.random.fold_in(key, 1))
+    timed("sort 1M int32 (1 key)",
+          jax.jit(lambda v: jax.lax.sort((v,), num_keys=1)), x, iters=iters)
+    timed("sort 1M int32 (2 keys + payload)",
+          jax.jit(lambda v: jax.lax.sort((v, v, v), num_keys=2)), x,
+          iters=iters)
+    timed("sort 61M int32 (2 keys + payload)",
+          jax.jit(lambda v: jax.lax.sort((v, v, v), num_keys=2)), big,
+          iters=max(1, iters // 4))
+    timed("cumsum 1M", jax.jit(jnp.cumsum), x, iters=iters)
+    timed("associative_scan 1M",
+          jax.jit(lambda v: jax.lax.associative_scan(jnp.add, v)), x,
+          iters=iters)
+
+
+def suite_gather(iters):
+    for width in (128, 256, 512):
+        rows = E // width
+        tbl = jax.jit(lambda k, r=rows, w=width: jax.random.bits(
+            k, (r, w)).astype(jnp.int32))(key)
+        ids = jax.jit(lambda k, r=rows: jax.random.randint(
+            k, (180_224,), 0, r, dtype=jnp.int32))(
+                jax.random.fold_in(key, 2))
+        timed(f"gather 180k rows of [E/{width}, {width}]",
+              jax.jit(lambda t, i: t[i]), tbl, ids, iters=iters)
+
+
+def suite_layout(iters):
+    from quiver_tpu.ops import (as_index_rows, as_index_rows_overlapping,
+                                sample_layer_rotation)
+    N = 2_450_000
+    AVG = 25
+
+    @jax.jit
+    def graph(k):
+        ln = jax.random.normal(k, (N,)) + jnp.log(float(AVG))
+        deg = jnp.clip(jnp.exp(ln).astype(jnp.int32), 0, 10_000)
+        return jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(deg)])
+
+    indptr = graph(key)
+    e = int(indptr[-1])
+    indices = jax.jit(lambda k: jax.random.randint(
+        k, (e,), 0, N, dtype=jnp.int32))(jax.random.fold_in(key, 1))
+    pair = jax.block_until_ready(jax.jit(as_index_rows)(indices))
+    over = jax.block_until_ready(
+        jax.jit(as_index_rows_overlapping)(indices))
+    print(f"graph: {N} nodes {e} edges | pair {pair.nbytes / 1e6:.0f} MB, "
+          f"overlap {over.nbytes / 1e6:.0f} MB")
+
+    fronts = [(1024, 15), (16384, 10), (180224, 5)]
+    for s, k in fronts:
+        def run_pair(indptr, rows, kk, s=s, k=k):
+            seeds = jax.random.randint(kk, (s,), 0, N, dtype=jnp.int32)
+            n, c = sample_layer_rotation(indptr, rows, seeds, k, kk)
+            return jnp.sum(c)
+
+        def run_over(indptr, rows, kk, s=s, k=k):
+            seeds = jax.random.randint(kk, (s,), 0, N, dtype=jnp.int32)
+            n, c = sample_layer_rotation(indptr, rows, seeds, k, kk,
+                                         stride=128)
+            return jnp.sum(c)
+
+        timed(f"hop s={s:>7} k={k:>2} pair   (2 gathers)",
+              jax.jit(run_pair), indptr, pair,
+              jax.random.fold_in(key, 7), iters=iters)
+        timed(f"hop s={s:>7} k={k:>2} overlap (1 gather)",
+              jax.jit(run_over), indptr, over,
+              jax.random.fold_in(key, 7), iters=iters)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", default="layout",
+                    choices=["primitives", "gather", "layout"])
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    print(f"platform: {jax.devices()[0].platform}")
+    {"primitives": suite_primitives,
+     "gather": suite_gather,
+     "layout": suite_layout}[args.suite](args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,7 @@ from .sample import (
     sample_layer_rotation,
     permute_csr,
     as_index_rows,
+    as_index_rows_overlapping,
     edge_row_ids,
     compact_layer,
     sample_prob_step,
@@ -21,6 +22,7 @@ __all__ = [
     "sample_layer_rotation",
     "permute_csr",
     "as_index_rows",
+    "as_index_rows_overlapping",
     "edge_row_ids",
     "compact_layer",
     "sample_prob_step",

@@ -173,31 +173,64 @@ def as_index_rows(indices: jax.Array, width: int = 128) -> jax.Array:
         [indices, jnp.zeros((pad,), indices.dtype)]).reshape(rows, width)
 
 
+def as_index_rows_overlapping(indices: jax.Array,
+                              width: int = 128) -> jax.Array:
+    """Overlapping 2*width-wide view of the CSR ``indices`` array:
+    row i covers flat positions [i*width, i*width + 2*width). Any
+    k <= width consecutive-position window [p, p+k) then fits entirely
+    inside row p // width, so ``sample_layer_rotation`` needs ONE row
+    gather per seed instead of the two the non-overlapping layout
+    requires to cover boundary-crossing windows. Costs 2x the memory of
+    ``as_index_rows`` — the trade the hot sampling path wants when the
+    edge array fits HBM twice."""
+    e = indices.shape[0]
+    rows = (e + 2 * width - 1) // width + 1
+    pad = rows * width - e
+    flat = jnp.concatenate([indices, jnp.zeros((pad,), indices.dtype)])
+    base = flat.reshape(rows, width)
+    nxt = jnp.concatenate([base[1:], jnp.zeros_like(base[:1])])
+    return jnp.concatenate([base, nxt], axis=1)        # [rows, 2*width]
+
+
 def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
                           seeds: jax.Array, k: int, key: jax.Array,
-                          with_slots: bool = False):
+                          with_slots: bool = False,
+                          stride: int | None = None):
     """Rotation sampling: draw ``min(deg, k)`` *consecutive* entries of the
     (pre-shuffled) neighbor row at a uniform random offset.
 
     With rows re-shuffled every epoch (``permute_csr``), each draw is
     marginally uniform over the true neighbors and slots are distinct —
     the same guarantees the reference's reservoir kernel provides
-    (cuda_random.cu.hpp:7-69) — while the per-seed memory traffic is two
-    128-wide row fetches instead of k scattered loads. Subsets within one
-    epoch are limited to runs of that epoch's shuffle (documented
+    (cuda_random.cu.hpp:7-69) — while the per-seed memory traffic is one
+    or two wide row fetches instead of k scattered loads. Subsets within
+    one epoch are limited to runs of that epoch's shuffle (documented
     trade-off; use ``sample_layer`` for i.i.d. exact subsets).
 
     Returns (neighbors [bs, k] -1 fill, counts [bs]).
 
-    The row width is taken from ``indices_rows.shape[1]`` (the
-    ``as_index_rows`` width), so non-default widths work; ``k`` must not
-    exceed it.
+    Layouts:
+    - ``as_index_rows`` (default, ``stride`` omitted): rows are disjoint
+      ``width``-wide blocks; TWO row gathers build a 2*width window that
+      covers any boundary-crossing pick run. ``k`` <= width.
+    - ``as_index_rows_overlapping`` + ``stride=width``: rows overlap
+      (each covers [i*stride, i*stride + 2*stride)), so any pick run
+      [p, p+k) with ``k`` <= stride+1 sits inside row p // stride: ONE
+      gather per seed — half the gather traffic of the default layout,
+      for 2x index memory.
     """
     width = indices_rows.shape[1]
-    if k > width:
+    overlap = stride is not None
+    if overlap and width != 2 * stride:
+        # a mismatched layout would silently gather the wrong CSR rows
         raise ValueError(
-            f"sample_layer_rotation supports k <= row width {width} (got "
-            f"{k}): the two-row window only covers picks [off, off+k)")
+            f"stride={stride} requires an as_index_rows_overlapping "
+            f"layout of width 2*stride={2 * stride}, got width {width}")
+    w_eff = (stride + 1) if overlap else width
+    if k > w_eff:
+        raise ValueError(
+            f"sample_layer_rotation supports k <= {w_eff} for this layout "
+            f"(got {k}): the row window only covers picks [off, off+k)")
     n = indptr.shape[0] - 1
     valid = seeds >= 0
     safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
@@ -209,12 +242,18 @@ def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
     span = jnp.maximum(deg - k, 0) + 1
     o = jax.random.randint(key, (bs,), 0, span, dtype=jnp.int32)
     p0 = start + o.astype(start.dtype)
-    r0 = (p0 // width).astype(jnp.int32)
-    off = (p0 % width).astype(jnp.int32)
-    # two row-gathers -> a 2*width window that always covers picks
-    # [off, off + k) since k <= width
-    w = jnp.concatenate(
-        [indices_rows[r0], indices_rows[r0 + 1]], axis=1)   # [bs, 2*width]
+    if overlap:
+        r0 = (p0 // stride).astype(jnp.int32)
+        off = (p0 % stride).astype(jnp.int32)
+        # one row-gather: the overlapping row always covers [off, off+k)
+        w = indices_rows[r0]                                # [bs, 2*stride]
+    else:
+        r0 = (p0 // width).astype(jnp.int32)
+        off = (p0 % width).astype(jnp.int32)
+        # two row-gathers -> a 2*width window that always covers picks
+        # [off, off + k) since k <= width
+        w = jnp.concatenate(
+            [indices_rows[r0], indices_rows[r0 + 1]], axis=1)
     wiota = jax.lax.broadcasted_iota(jnp.int32, (1, w.shape[1]), 1)
     cols = []
     for j in range(k):

@@ -9,8 +9,9 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .sample import (LayerSample, as_index_rows, compact_layer, edge_rows,
-                     permute_csr, sample_layer, sample_layer_rotation)
+from .sample import (LayerSample, as_index_rows, as_index_rows_overlapping,
+                     compact_layer, edge_rows, permute_csr, sample_layer,
+                     sample_layer_rotation)
 from .weighted import sample_layer_weighted
 
 
@@ -20,6 +21,7 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                     method: str = "exact",
                     indices_rows: jax.Array | None = None,
                     eid=None,
+                    indices_stride: int | None = None,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
@@ -38,6 +40,10 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     ``edge_weight`` (CSR-slot-aligned) switches every hop to weighted
     sampling (always exact).
 
+    ``indices_stride``: set to the build width (128) when
+    ``indices_rows`` came from ``as_index_rows_overlapping`` — rotation
+    then does ONE row gather per seed instead of two (2x index memory).
+
     ``eid`` enables per-edge id tracking (off by default — it adds one
     scattered gather per sampled edge, which the fused training path
     doesn't want): ``True`` stamps each sampled edge with its CSR slot;
@@ -54,15 +60,18 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
         # under-samples row-endpoint neighbors
         pkey = jax.random.fold_in(key, len(sizes))  # hops use 0..len-1
         rids = edge_rows(indptr, indices.shape[0])
+        as_rows = (as_index_rows if indices_stride is None else
+                   (lambda ix: as_index_rows_overlapping(
+                       ix, width=indices_stride)))
         if track_eid:
             # rotation slots index the permuted array; compose the
             # caller's eid map with the permutation's slot map
             permuted, smap = permute_csr(indices, rids, pkey,
                                          with_slot_map=True)
             eid = smap if eid is True else jnp.asarray(eid)[smap]
-            indices_rows = as_index_rows(permuted)
+            indices_rows = as_rows(permuted)
         else:
-            indices_rows = as_index_rows(permute_csr(indices, rids, pkey))
+            indices_rows = as_rows(permute_csr(indices, rids, pkey))
     layers: List[LayerSample] = []
     for i, k in enumerate(sizes):
         sub = jax.random.fold_in(key, i)
@@ -72,7 +81,8 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                                         cur, k, sub, with_slots=track_eid)
         elif method == "rotation":
             out = sample_layer_rotation(indptr, indices_rows, cur, k, sub,
-                                        with_slots=track_eid)
+                                        with_slots=track_eid,
+                                        stride=indices_stride)
         else:
             out = sample_layer(indptr, indices, cur, k, sub,
                                with_slots=track_eid)

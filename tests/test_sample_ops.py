@@ -165,6 +165,68 @@ class TestRotationSampler:
             assert len(got) == counts[i]
             assert set(got.tolist()) <= nsets[v]
 
+    def test_overlapping_layout_identical_draws(self, small_graph):
+        # the one-gather overlapping layout must produce EXACTLY the
+        # draws of the two-gather pair layout under the same key — it is
+        # a memory-layout change, not a sampler change
+        from quiver_tpu.ops import (as_index_rows,
+                                    as_index_rows_overlapping,
+                                    sample_layer_rotation)
+        indptr, indices = small_graph
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        for k in (3, 15):
+            pair = as_index_rows(jnp.asarray(indices))
+            over = as_index_rows_overlapping(jnp.asarray(indices))
+            assert over.shape[1] == 256
+            a, ca = sample_layer_rotation(
+                jnp.asarray(indptr), pair, jnp.asarray(seeds), k, KEY)
+            b, cb = sample_layer_rotation(
+                jnp.asarray(indptr), over, jnp.asarray(seeds), k, KEY,
+                stride=128)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+    def test_overlapping_layout_slots_and_multihop(self, small_graph):
+        from quiver_tpu.ops import (as_index_rows,
+                                    as_index_rows_overlapping,
+                                    sample_layer_rotation, sample_multihop)
+        indptr, indices = small_graph
+        seeds = np.arange(0, 60, dtype=np.int32)
+        pair = as_index_rows(jnp.asarray(indices))
+        over = as_index_rows_overlapping(jnp.asarray(indices))
+        _, _, sa = sample_layer_rotation(
+            jnp.asarray(indptr), pair, jnp.asarray(seeds), 4, KEY,
+            with_slots=True)
+        _, _, sb = sample_layer_rotation(
+            jnp.asarray(indptr), over, jnp.asarray(seeds), 4, KEY,
+            with_slots=True, stride=128)
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+        # end-to-end through sample_multihop
+        na, la = sample_multihop(jnp.asarray(indptr), jnp.asarray(indices),
+                                 jnp.asarray(seeds), [4, 3], KEY,
+                                 method="rotation", indices_rows=pair)
+        nb, lb = sample_multihop(jnp.asarray(indptr), jnp.asarray(indices),
+                                 jnp.asarray(seeds), [4, 3], KEY,
+                                 method="rotation", indices_rows=over,
+                                 indices_stride=128)
+        np.testing.assert_array_equal(np.asarray(na), np.asarray(nb))
+        for A, B in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(A.row),
+                                          np.asarray(B.row))
+            np.testing.assert_array_equal(np.asarray(A.col),
+                                          np.asarray(B.col))
+
+    def test_stride_layout_mismatch_raises(self, small_graph):
+        # a stride that doesn't match the layout width must error, not
+        # silently gather the wrong CSR rows
+        from quiver_tpu.ops import as_index_rows, sample_layer_rotation
+        indptr, indices = small_graph
+        pair = as_index_rows(jnp.asarray(indices))       # width 128
+        with pytest.raises(ValueError, match="as_index_rows_overlapping"):
+            sample_layer_rotation(jnp.asarray(indptr), pair,
+                                  jnp.zeros((4,), jnp.int32), 3, KEY,
+                                  stride=128)   # needs width 256, got 128
+
     def test_multihop_rotation_fallback_is_shuffled(self):
         # ADVICE r1 (medium): rotation with indices_rows=None must not
         # sample consecutive runs of the raw CSR order — the fallback now
