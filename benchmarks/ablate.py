@@ -14,8 +14,7 @@ jax.config.update("jax_compilation_cache_dir",
                                "..", ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-from quiver_tpu.ops.sample import (sample_layer, compact_layer, compact_ids,
-                                   LayerSample)
+from quiver_tpu.ops.sample import (sample_layer, compact_layer)
 
 N = 2_450_000
 AVG = 25

@@ -3,7 +3,6 @@
 import os
 import sys
 import time
-import functools
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -10,7 +10,6 @@ asynchronously and only materializes on use.
 
 from __future__ import annotations
 
-from typing import Sequence
 
 import jax
 import jax.numpy as jnp

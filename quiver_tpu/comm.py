@@ -16,13 +16,12 @@ XLA collective scheduler owns link contention).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 

@@ -28,14 +28,13 @@ construction metadata.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .shard_tensor import ShardTensor, ShardTensorConfig
 from .utils import CSRTopo, parse_size, reindex_feature
 
 
@@ -216,7 +215,23 @@ class Feature:
         if out is None:
             shape = (ids_np.shape[0],) + host_rows.shape[1:]
             out = jnp.zeros(shape, dtype=host_rows.dtype)
-        return out.at[jnp.asarray(pos)].set(jax.device_put(host_rows))
+        # pad the scatter to the next power of two: the cold-row count is
+        # data-dependent, and a distinct shape per batch would compile
+        # (and cache) a new executable every lookup — unbounded memory
+        # growth plus per-batch compile stalls (caught by
+        # scripts/check_leak.py). Pad positions land past the end and
+        # mode="drop" discards them.
+        # (pad on HOST: device-side padding of the unbucketed array would
+        # itself compile one concat executable per distinct cold count —
+        # the very growth the bucketing exists to stop. The cost is up to
+        # 2x H2D bytes on pathological bucket boundaries, ~1x typically.)
+        bucket = 1 << max(int(pos.size) - 1, 0).bit_length()
+        rows_p = np.zeros((bucket,) + host_rows.shape[1:], host_rows.dtype)
+        rows_p[:pos.size] = host_rows
+        pos_p = np.full(bucket, out.shape[0], pos.dtype)  # OOB -> dropped
+        pos_p[:pos.size] = pos
+        return out.at[jnp.asarray(pos_p)].set(jax.device_put(rows_p),
+                                              mode="drop")
 
     def prefetch(self, node_idx):
         """Start this lookup on a background thread and return a

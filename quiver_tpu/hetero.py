@@ -23,7 +23,6 @@ from typing import Dict, List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from .ops.sample import compact_union, sample_layer
 from .pyg.sage_sampler import Adj

@@ -10,7 +10,6 @@ under jit; same normalization role)."""
 from __future__ import annotations
 
 import flax.linen as nn
-import jax
 
 from .gat import GATConv
 from .sage import SAGEConv

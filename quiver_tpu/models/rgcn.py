@@ -11,7 +11,7 @@ so the PyG ``x_target = x[:cap]`` pattern works per node type.
 
 from __future__ import annotations
 
-from typing import Dict, Sequence
+from typing import Dict
 
 import flax.linen as nn
 import jax

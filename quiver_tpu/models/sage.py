@@ -12,7 +12,6 @@ zeroes the message and the count.
 
 from __future__ import annotations
 
-from typing import Sequence
 
 import flax.linen as nn
 import jax

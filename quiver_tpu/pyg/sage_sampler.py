@@ -20,7 +20,7 @@ a jit-first design:
 from __future__ import annotations
 
 import time
-from typing import Generic, List, NamedTuple, Optional, Sequence, TypeVar
+from typing import Generic, List, NamedTuple, Sequence, TypeVar
 
 import jax
 import jax.numpy as jnp

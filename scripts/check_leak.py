@@ -1,0 +1,76 @@
+"""Leak check: repeated sample + gather cycles must not grow buffers.
+
+The TPU analogue of the reference's scripts/check-leak (which watches
+CUDA memory across epochs): run many sampler + tiered-feature-lookup +
+prefetch cycles and assert that (a) the number of live jax arrays and
+(b) host RSS stay bounded — i.e. per-batch work leaks neither device
+buffers nor host memory. Runs on the CPU backend so CI can gate on it.
+
+Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
+"""
+
+import gc
+import os
+import resource
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+def main():
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+    import quiver_tpu as qv
+
+    rng = np.random.default_rng(0)
+    n, dim = 50_000, 64
+    deg = rng.poisson(12, n).astype(np.int64)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]))
+    topo = qv.CSRTopo(indptr=indptr, indices=indices)
+    sampler = qv.GraphSageSampler(topo, [10, 5])
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+    store = qv.Feature(device_cache_size=n // 4 * dim * 4, csr_topo=topo)
+    store.from_cpu_tensor(feat)
+
+    def cycle(i):
+        seeds = jnp.asarray(
+            rng.integers(0, n, 512, dtype=np.int32))
+        n_id, bs, adjs = sampler.sample(seeds)
+        fut = store.prefetch(n_id)
+        x = fut.result()
+        jax.block_until_ready(x)
+
+    # warmup: compile everything, let caches fill
+    for i in range(5):
+        cycle(i)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_rss = rss_mb()
+
+    for i in range(60):
+        cycle(100 + i)
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    rss = rss_mb()
+
+    print(f"live arrays: {base_arrays} -> {arrays}")
+    print(f"max RSS: {base_rss:.0f} MB -> {rss:.0f} MB")
+    # steady state may wobble by a few in-flight buffers, never grow
+    # linearly with cycles (60 cycles x ~10 arrays each would be +600)
+    assert arrays <= base_arrays + 16, "device buffer leak"
+    assert rss <= base_rss + 256, "host memory leak"
+    print("no leak detected")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+#!/bin/sh
+# Lint the repo with tools the baked image actually has (stdlib only) —
+# the TPU-repo analogue of the reference's scripts/lint.sh (yapf +
+# clang-format there; neither exists here, and nothing may be
+# pip-installed). Checks:
+#   - every python source byte-compiles (syntax)
+#   - no tabs/indentation ambiguity (tabnanny)
+#   - unused imports (AST walk)
+#   - the native C++ engine passes g++ -fsyntax-only
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== py_compile + tabnanny + unused imports =="
+python - <<'EOF'
+import ast, pathlib, py_compile, sys, tabnanny
+
+fail = 0
+srcs = [p for d in ("quiver_tpu", "tests", "benchmarks", "examples")
+        for p in pathlib.Path(d).rglob("*.py")]
+srcs += [pathlib.Path("bench.py"), pathlib.Path("__graft_entry__.py")]
+for p in srcs:
+    try:
+        py_compile.compile(str(p), doraise=True)
+        tabnanny.check(str(p))
+    except Exception as e:
+        print(f"FAIL {p}: {e}")
+        fail = 1
+    tree = ast.parse(p.read_text())
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = node.lineno
+    used = {n.id for n in ast.walk(tree) if isinstance(n, ast.Name)}
+    used |= {n.attr for n in ast.walk(tree) if isinstance(n, ast.Attribute)}
+    src = p.read_text()
+    for name, line in sorted(imported.items()):
+        if name in used or name == "annotations":
+            continue
+        # __init__.py re-exports are the public API, not unused
+        if p.name == "__init__.py":
+            continue
+        print(f"UNUSED-IMPORT {p}:{line}: {name}")
+        fail = 1
+sys.exit(fail)
+EOF
+
+echo "== native C++ syntax =="
+for src in quiver_tpu/native/*.cpp; do
+    g++ -std=c++17 -fsyntax-only "$src"
+    echo "ok $src"
+done
+echo "lint clean"

@@ -7,7 +7,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import optax
-import pytest
 
 import quiver_tpu as qv
 from quiver_tpu import checkpoint, profiling

@@ -4,9 +4,7 @@ numpy oracle, and agreement with the trained flax GraphSAGE params."""
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
-import quiver_tpu as qv
 from quiver_tpu.inference import (layerwise_inference, neighborhood_block,
                                   sage_apply_layer)
 

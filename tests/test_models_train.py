@@ -14,7 +14,7 @@ import pytest
 import quiver_tpu as qv
 from quiver_tpu.models import GraphSAGE, GAT
 from quiver_tpu.parallel import (
-    TrainState, build_train_step, build_e2e_train_step, make_mesh)
+    build_train_step, build_e2e_train_step, make_mesh)
 from quiver_tpu.parallel.train import init_state, layers_to_adjs
 from quiver_tpu.ops import sample_multihop, as_index_rows
 

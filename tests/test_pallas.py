@@ -2,7 +2,6 @@
 oracles)."""
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 from jax.experimental.pallas import tpu as pltpu

@@ -5,7 +5,6 @@ membership checks (test_quiver_cpu.cpp:9-78) for the native engine.
 """
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 
