@@ -1,0 +1,19 @@
+"""Shared setup for the benchmark scripts."""
+
+import os
+
+
+def configure_jax():
+    """Honor JAX_PLATFORMS (the axon TPU bootstrap force-registers the
+    TPU platform; the config knob wins over it) and enable the
+    persistent compile cache so repeated bench runs skip the slow
+    remote TPU compile. Call before any jax computation."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax

@@ -73,9 +73,8 @@ def main():
                          "differences can show")
     args = ap.parse_args()
 
-    import jax
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from _common import configure_jax
+    jax = configure_jax()
     import jax.numpy as jnp
     import optax
     from quiver_tpu.models import GraphSAGE

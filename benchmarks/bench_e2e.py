@@ -29,20 +29,19 @@ def main():
     p.add_argument("--batches", type=int, default=192)
     p.add_argument("--method", default="rotation",
                    choices=["rotation", "exact"])
+    p.add_argument("--layout", default="pair", choices=["pair", "overlap"],
+                   help="rotation row layout (overlap = one gather/seed)")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 feature storage")
     args = p.parse_args()
 
-    import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   "..", ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from _common import configure_jax
+    jax = configure_jax()
     import jax.numpy as jnp
     import optax
     from quiver_tpu.models import GraphSAGE
     from quiver_tpu.ops import (sample_multihop, permute_csr, edge_row_ids,
-                                as_index_rows)
+                                as_index_rows, as_index_rows_overlapping)
     from quiver_tpu.parallel.train import (
         TrainState, _fused_loss, cross_entropy_logits, layers_to_adjs,
         masked_feature_gather)
@@ -87,13 +86,16 @@ def main():
     state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
 
     method = args.method
+    stride = 128 if (method == "rotation" and args.layout == "overlap") \
+        else None
 
     @jax.jit
     def epoch(state, indptr, indices, row_ids, feat, labels_all, key):
         if method == "rotation":
             permuted = permute_csr(indices, row_ids,
                                    jax.random.fold_in(key, 0))
-            rows = as_index_rows(permuted)
+            rows = (as_index_rows_overlapping(permuted) if stride
+                    else as_index_rows(permuted))
         else:
             permuted, rows = indices, None
         seed_perm = jax.random.permutation(
@@ -108,7 +110,8 @@ def main():
             loss, grads = jax.value_and_grad(
                 lambda prm: _fused_loss(
                     model, cross_entropy_logits, sizes, bs, prm, feat, None,
-                    indptr, permuted, seeds, labels, kb, method, rows)
+                    indptr, permuted, seeds, labels, kb, method, rows,
+                    stride)
             )(state.params)
             updates, opt_state = tx.update(grads, state.opt_state,
                                            state.params)
@@ -130,7 +133,9 @@ def main():
         epoch(state, indptr, indices, row_ids, feat, labels_all,
               jax.random.fold_in(key, 2000)))
     dt = time.perf_counter() - t0
-    print(f"[{method}{' bf16' if args.bf16 else ''}] epoch "
+    print(f"[{method}"
+          f"{'/' + args.layout if method == 'rotation' else ''}"
+          f"{' bf16' if args.bf16 else ''}] epoch "
           f"{dt:.2f}s ({args.batches} batches x {bs}; "
           f"first+compile {compile_and_first:.1f}s)  "
           f"loss mean {float(lm):.4f} tail {float(ll):.4f}  "

@@ -46,11 +46,8 @@ def main():
                    help="with --tiered: double-buffer via prefetch()")
     args = p.parse_args()
 
-    import jax
-    # the axon TPU bootstrap force-registers the TPU platform; the config
-    # knob wins over it so JAX_PLATFORMS=cpu is honored
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from _common import configure_jax
+    jax = configure_jax()
     import jax.numpy as jnp
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32

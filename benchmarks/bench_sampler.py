@@ -9,7 +9,11 @@ Usage: python benchmarks/bench_sampler.py [--nodes N] [--batch B]
 """
 
 import argparse
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -20,15 +24,30 @@ def main():
     p.add_argument("--batches", type=int, default=20)
     p.add_argument("--sizes", type=int, nargs="+", default=[15, 10, 5])
     p.add_argument("--pallas", action="store_true",
-                   help="use the Pallas sampling kernel for hop 1")
+                   help="use the Pallas sampling kernel (single hop, "
+                        "sizes[0]) — compare against --hop1 variants")
+    p.add_argument("--hop1", default=None, choices=["exact", "rotation"],
+                   help="single-hop jnp sampler at sizes[0] — the "
+                        "apples-to-apples baseline for --pallas")
     p.add_argument("--row-cap", type=int, default=2048)
     args = p.parse_args()
 
-    import jax
+    from _common import configure_jax
+    jax = configure_jax()
     import jax.numpy as jnp
-    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.ops import (as_index_rows_overlapping, edge_row_ids,
+                                permute_csr, sample_layer,
+                                sample_layer_rotation, sample_multihop)
     from quiver_tpu.ops.pallas.sample_kernel import (
         pad_indices, sample_layer_pallas)
+
+    if args.pallas and jax.devices()[0].platform != "tpu":
+        # pltpu.prng_seed has no native CPU lowering, and the TPU
+        # interpreter is orders of magnitude too slow at bench sizes —
+        # this comparison is chip-only (tests/test_pallas.py covers the
+        # kernel's logic under the interpreter at toy sizes). Checked
+        # before the ~61M-edge graph build, which would be wasted work.
+        sys.exit("--pallas needs a real TPU")
 
     key = jax.random.key(0)
     n = args.nodes
@@ -57,6 +76,25 @@ def main():
                 indptr, indices_p, seeds, args.sizes[0], seed_scalar,
                 row_cap=args.row_cap)
             return nbrs, jnp.sum(counts)
+    elif args.hop1 == "exact":
+        @jax.jit
+        def run(seeds, k):
+            nbrs, counts = sample_layer(indptr, indices, seeds,
+                                        args.sizes[0], k)
+            return nbrs, jnp.sum(counts)
+    elif args.hop1 == "rotation":
+        rids = jax.jit(edge_row_ids, static_argnums=1)(indptr, e)
+        rows = jax.block_until_ready(jax.jit(
+            lambda ix, r, kk: as_index_rows_overlapping(
+                permute_csr(ix, r, kk)))(indices, rids,
+                                         jax.random.fold_in(key, 9)))
+
+        @jax.jit
+        def run(seeds, k):
+            nbrs, counts = sample_layer_rotation(indptr, rows, seeds,
+                                                 args.sizes[0], k,
+                                                 stride=128)
+            return nbrs, jnp.sum(counts)
     else:
         @jax.jit
         def run(seeds, k):
@@ -81,7 +119,8 @@ def main():
         total += int(edges)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    label = "pallas-hop1" if args.pallas else f"jnp {args.sizes}"
+    label = ("pallas-hop1" if args.pallas else
+             f"jnp-hop1-{args.hop1}" if args.hop1 else f"jnp {args.sizes}")
     print(f"[{label}] {total} edges in {dt:.3f}s -> "
           f"SEPS = {total / dt / 1e6:.2f} M")
 

@@ -1,0 +1,69 @@
+#!/bin/sh
+# Run every on-chip measurement in one sweep, highest-value first, each
+# step with a generous timeout (killing a TPU process mid-claim can
+# wedge the device for a long time — prefer to let steps finish).
+# Output is unbuffered; tee everything to benchmarks/chip_suite.log.
+#
+# Usage: sh benchmarks/chip_suite.sh [quick]
+#   quick = skip the e2e epoch runs and doc micro tables (sections 6-7)
+cd "$(dirname "$0")/.."
+LOG=benchmarks/chip_suite.log
+QUICK="$1"
+T=1800
+
+# pipeline status would be tee's, not the command's (POSIX sh has no
+# PIPESTATUS) — capture the real rc via a temp file so a crash or a
+# 1800s timeout is loudly marked in the log instead of reading as a
+# silently truncated success
+step() {
+    echo "=== $* ===" | tee -a "$LOG"
+    rcfile=$(mktemp)
+    { timeout $T "$@" 2>&1; echo $? > "$rcfile"; } \
+        | grep -v "WARNING" | tee -a "$LOG"
+    rc=$(cat "$rcfile"); rm -f "$rcfile"
+    if [ "$rc" != "0" ]; then
+        echo "=== FAILED rc=$rc (124=timeout): $* ===" | tee -a "$LOG"
+    fi
+}
+
+: > "$LOG"
+date | tee -a "$LOG"
+
+# 1. rotation layout decision (drives bench.py's QT_BENCH_LAYOUT default)
+step python -u benchmarks/micro_ops.py --suite layout --iters 10
+
+# 2. metric of record, both layouts
+step env QT_BENCH_LAYOUT=pair python -u bench.py
+step env QT_BENCH_LAYOUT=overlap python -u bench.py
+
+# 3. per-stage profile of the production path
+step python -u benchmarks/profile_stages.py --iters 10
+
+# 4. feature gather GB/s: raw device, pallas kernel, tiered grid
+step python -u benchmarks/bench_feature.py
+step python -u benchmarks/bench_feature.py --bf16
+step python -u benchmarks/bench_feature.py --pallas
+step python -u benchmarks/bench_feature.py --tiered 1.0
+step python -u benchmarks/bench_feature.py --tiered 0.2 --batch 100000
+step python -u benchmarks/bench_feature.py --tiered 0.2 --batch 100000 --prefetch
+step python -u benchmarks/bench_feature.py --tiered 0.0 --batch 100000
+step python -u benchmarks/bench_feature.py --tiered 0.0 --batch 100000 --prefetch
+
+# 5. pallas sampling kernel vs jnp hop-1 (apples-to-apples)
+step python -u benchmarks/bench_sampler.py --pallas
+step python -u benchmarks/bench_sampler.py --hop1 exact
+step python -u benchmarks/bench_sampler.py --hop1 rotation
+
+if [ "$QUICK" != "quick" ]; then
+    # 6. end-to-end epoch seconds vs the reference's 11.1 s
+    step python -u benchmarks/bench_e2e.py --method rotation --layout overlap
+    step python -u benchmarks/bench_e2e.py --method rotation --layout pair
+    step python -u benchmarks/bench_e2e.py --method exact
+    step python -u benchmarks/bench_e2e.py --method rotation --layout overlap --bf16
+    # 7. primitive/gather micro tables for the docs
+    step python -u benchmarks/micro_ops.py --suite gather --iters 10
+    step python -u benchmarks/micro_ops.py --suite primitives --iters 10
+fi
+
+date | tee -a "$LOG"
+echo "chip suite complete -> $LOG"

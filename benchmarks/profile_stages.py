@@ -15,13 +15,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import jax.numpy as jnp
+from _common import configure_jax
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "..", ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax = configure_jax()
+import jax.numpy as jnp
 
 from quiver_tpu.ops.sample import (as_index_rows, compact_layer,
                                    edge_row_ids, permute_csr, sample_layer,

@@ -66,9 +66,10 @@ def masked_feature_gather(feat: jax.Array, n_id: jax.Array,
 
 def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
                 indptr, indices, seeds, labels, key, method="exact",
-                indices_rows=None):
+                indices_rows=None, indices_stride=None):
     n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
-                                   method=method, indices_rows=indices_rows)
+                                   method=method, indices_rows=indices_rows,
+                                   indices_stride=indices_stride)
     x = masked_feature_gather(feat, n_id, forder)
     adjs = layers_to_adjs(layers, batch_size, sizes)
     logits = model.apply(params, x, adjs, train=True,
@@ -78,12 +79,15 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
 
 def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                      loss_fn: Callable = cross_entropy_logits,
-                     method: str = "exact"):
+                     method: str = "exact",
+                     indices_stride: int | None = None):
     """Single-chip fused step:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]). With ``method="rotation"`` pass the shuffled
     ``as_index_rows`` view as ``indices_rows`` (refresh per epoch with
-    ``permute_csr``)."""
+    ``permute_csr``) — or, with ``indices_stride=128``, the
+    ``as_index_rows_overlapping`` view (one row gather per seed, 2x
+    index memory)."""
     sizes = list(sizes)
 
     @jax.jit
@@ -92,7 +96,7 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
         loss, grads = jax.value_and_grad(
             lambda p: _fused_loss(model, loss_fn, sizes, batch_size, p, feat,
                                   forder, indptr, indices, seeds, labels, key,
-                                  method, indices_rows)
+                                  method, indices_rows, indices_stride)
         )(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -105,12 +109,15 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                          per_device_batch: int, mesh: Mesh,
                          axis: str = "data",
                          loss_fn: Callable = cross_entropy_logits,
-                         method: str = "exact"):
+                         method: str = "exact",
+                         indices_stride: int | None = None):
     """Data-parallel fused step over ``mesh[axis]``:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]) with seeds/labels [n_dev * per_device_batch] sharded
     over ``axis``; state/feat/topology (and the shuffled rows view when
-    ``method="rotation"``) replicated; grads pmean over ``axis``."""
+    ``method="rotation"``) replicated; grads pmean over ``axis``.
+    ``indices_stride=128`` switches ``indices_rows`` to the
+    ``as_index_rows_overlapping`` layout (one row gather per seed)."""
     sizes = list(sizes)
 
     def per_shard(state: TrainState, feat, forder, indptr, indices, seeds,
@@ -119,7 +126,8 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
         loss, grads = jax.value_and_grad(
             lambda p: _fused_loss(model, loss_fn, sizes, per_device_batch, p,
                                   feat, forder, indptr, indices, seeds,
-                                  labels, key, method, indices_rows)
+                                  labels, key, method, indices_rows,
+                                  indices_stride)
         )(state.params)
         grads = jax.lax.pmean(grads, axis)
         loss = jax.lax.pmean(loss, axis)
