@@ -1,0 +1,23 @@
+#!/bin/sh
+# Wait quietly for the TPU claim to unwedge, then run the measurement
+# sweep. Long probe timeouts on purpose: a probe killed mid-claim can
+# itself re-wedge the device, so probe rarely and patiently.
+cd "$(dirname "$0")/.."
+LOG=benchmarks/chip_watch.log
+: > "$LOG"
+echo "$(date) watcher start (initial quiet period)" >> "$LOG"
+sleep 1800
+for i in 1 2 3 4 5 6 7 8; do
+    echo "$(date) probe round $i" >> "$LOG"
+    if timeout 600 python -c \
+        "import jax; d=jax.devices(); assert d[0].platform=='tpu'" \
+        >> "$LOG" 2>&1; then
+        echo "$(date) chip back on round $i; running suite" >> "$LOG"
+        sh benchmarks/chip_suite.sh >> "$LOG" 2>&1
+        echo "$(date) suite done" >> "$LOG"
+        exit 0
+    fi
+    echo "$(date) still wedged" >> "$LOG"
+    sleep 1500
+done
+echo "$(date) chip never returned" >> "$LOG"
