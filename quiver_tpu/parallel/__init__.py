@@ -5,6 +5,7 @@ from .train import (
     build_e2e_train_step,
     cross_entropy_logits,
 )
+from .gspmd import build_gspmd_train_step, shard_state, state_sharding
 
 __all__ = [
     "make_mesh",
@@ -13,5 +14,8 @@ __all__ = [
     "TrainState",
     "build_train_step",
     "build_e2e_train_step",
+    "build_gspmd_train_step",
+    "shard_state",
+    "state_sharding",
     "cross_entropy_logits",
 ]

@@ -1,0 +1,99 @@
+"""GSPMD 2-D mesh (data x model) train step: the TP-beyond-parity path.
+
+Verifies on the virtual 8-device mesh that the tensor-parallel fused
+step (a) really shards the kernels over the model axis, (b) produces
+the same loss/params as the plain single-chip step under identical
+keys (up to reduction order), and (c) trains."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops import sample_multihop
+from quiver_tpu.parallel import (build_gspmd_train_step, build_train_step,
+                                 shard_state)
+from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                       masked_feature_gather)
+
+
+@pytest.fixture
+def setup(rng):
+    n, dim, classes = 300, 16, 4
+    deg = rng.integers(1, 10, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    sizes, bs = [4, 3], 32
+    model = GraphSAGE(hidden_dim=16, out_dim=classes, num_layers=2,
+                      dropout=0.0)
+    tx = optax.adam(1e-2)
+    indptr_j = jnp.asarray(indptr.astype(np.int32))
+    indices_j = jnp.asarray(indices)
+    feat_j = jnp.asarray(feat)
+    n_id, layers = sample_multihop(indptr_j, indices_j,
+                                   jnp.arange(bs, dtype=jnp.int32), sizes,
+                                   jax.random.key(0))
+    state = init_state(model, tx, masked_feature_gather(feat_j, n_id),
+                       layers_to_adjs(layers, bs, sizes), jax.random.key(1))
+    return (model, tx, sizes, bs, indptr_j, indices_j, feat_j,
+            jnp.asarray(labels), state)
+
+
+def make_mesh_2d():
+    devs = np.array(jax.devices()).reshape(4, 2)
+    return Mesh(devs, axis_names=("data", "model"))
+
+
+class TestGspmdTrainStep:
+    def test_kernels_sharded_over_model_axis(self, setup):
+        model, tx, sizes, bs, indptr, indices, feat, labels, state = setup
+        mesh = make_mesh_2d()
+        st = shard_state(state, mesh)
+        kernel = st.params["params"]["conv0"]["lin_root"]["kernel"]
+        # column-sharded: each device holds out_dim/2 columns
+        shard_shapes = {s.data.shape for s in kernel.addressable_shards}
+        assert shard_shapes == {(kernel.shape[0], kernel.shape[1] // 2)}
+
+    def test_matches_single_chip_step(self, setup):
+        model, tx, sizes, bs, indptr, indices, feat, labels, state = setup
+        mesh = make_mesh_2d()
+        g = bs  # global batch (multiple of the data axis size 4)
+        seeds = jnp.arange(g, dtype=jnp.int32) * 3 % 300
+        y = labels[seeds]
+        key = jax.random.key(7)
+
+        ref_step = build_train_step(model, tx, sizes, g)
+        ref_state, ref_loss = ref_step(state, feat, None, indptr, indices,
+                                       seeds, y, key)
+
+        tp_step = build_gspmd_train_step(model, tx, sizes, mesh)
+        st = shard_state(state, mesh)
+        st, loss = tp_step(st, feat, None, indptr, indices, seeds, y, key)
+
+        assert np.allclose(float(loss), float(ref_loss), rtol=1e-5)
+        ref_k = np.asarray(
+            ref_state.params["params"]["conv1"]["lin_root"]["kernel"])
+        tp_k = np.asarray(
+            st.params["params"]["conv1"]["lin_root"]["kernel"])
+        np.testing.assert_allclose(tp_k, ref_k, rtol=1e-4, atol=1e-6)
+
+    def test_loss_decreases_over_steps(self, setup):
+        model, tx, sizes, bs, indptr, indices, feat, labels, state = setup
+        mesh = make_mesh_2d()
+        tp_step = build_gspmd_train_step(model, tx, sizes, mesh)
+        st = shard_state(state, mesh)
+        rng = np.random.default_rng(3)
+        losses = []
+        for it in range(12):
+            seeds = jnp.asarray(rng.integers(0, 300, bs, dtype=np.int32))
+            st, loss = tp_step(st, feat, None, indptr, indices, seeds,
+                               labels[seeds], jax.random.fold_in(
+                                   jax.random.key(9), it))
+            losses.append(float(loss))
+        assert np.mean(losses[-4:]) < np.mean(losses[:4])
