@@ -28,7 +28,7 @@ def main():
     p.add_argument("--batch", type=int, default=1024)
     p.add_argument("--batches", type=int, default=192)
     p.add_argument("--method", default="rotation",
-                   choices=["rotation", "exact"])
+                   choices=["rotation", "window", "exact"])
     p.add_argument("--layout", default="pair", choices=["pair", "overlap"],
                    help="rotation row layout (overlap = one gather/seed)")
     p.add_argument("--bf16", action="store_true",
@@ -86,12 +86,12 @@ def main():
     state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
 
     method = args.method
-    stride = 128 if (method == "rotation" and args.layout == "overlap") \
-        else None
+    windowed = method in ("rotation", "window")
+    stride = 128 if (windowed and args.layout == "overlap") else None
 
     @jax.jit
     def epoch(state, indptr, indices, row_ids, feat, labels_all, key):
-        if method == "rotation":
+        if windowed:
             permuted = permute_csr(indices, row_ids,
                                    jax.random.fold_in(key, 0))
             rows = (as_index_rows_overlapping(permuted) if stride
@@ -134,7 +134,7 @@ def main():
               jax.random.fold_in(key, 2000)))
     dt = time.perf_counter() - t0
     print(f"[{method}"
-          f"{'/' + args.layout if method == 'rotation' else ''}"
+          f"{'/' + args.layout if windowed else ''}"
           f"{' bf16' if args.bf16 else ''}] epoch "
           f"{dt:.2f}s ({args.batches} batches x {bs}; "
           f"first+compile {compile_and_first:.1f}s)  "

@@ -58,6 +58,7 @@ if [ "$QUICK" != "quick" ]; then
     # 6. end-to-end epoch seconds vs the reference's 11.1 s
     step python -u benchmarks/bench_e2e.py --method rotation --layout overlap
     step python -u benchmarks/bench_e2e.py --method rotation --layout pair
+    step python -u benchmarks/bench_e2e.py --method window --layout overlap
     step python -u benchmarks/bench_e2e.py --method exact
     step python -u benchmarks/bench_e2e.py --method rotation --layout overlap --bf16
     # 7. primitive/gather micro tables for the docs

@@ -73,7 +73,7 @@ def suite_gather(iters):
 
 def suite_layout(iters):
     from quiver_tpu.ops import (as_index_rows, as_index_rows_overlapping,
-                                sample_layer_rotation)
+                                sample_layer_rotation, sample_layer_window)
     N = 2_450_000
     AVG = 25
 
@@ -112,6 +112,16 @@ def suite_layout(iters):
               jax.random.fold_in(key, 7), iters=iters)
         timed(f"hop s={s:>7} k={k:>2} overlap (1 gather)",
               jax.jit(run_over), indptr, over,
+              jax.random.fold_in(key, 7), iters=iters)
+
+        def run_win(indptr, rows, kk, s=s, k=k):
+            seeds = jax.random.randint(kk, (s,), 0, N, dtype=jnp.int32)
+            n, c = sample_layer_window(indptr, rows, seeds, k, kk,
+                                       stride=128)
+            return jnp.sum(c)
+
+        timed(f"hop s={s:>7} k={k:>2} window  (1 gather + top_k)",
+              jax.jit(run_win), indptr, over,
               jax.random.fold_in(key, 7), iters=iters)
 
 

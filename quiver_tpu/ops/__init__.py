@@ -1,6 +1,7 @@
 from .sample import (
     sample_layer,
     sample_layer_rotation,
+    sample_layer_window,
     permute_csr,
     as_index_rows,
     as_index_rows_overlapping,
@@ -20,6 +21,7 @@ from .weighted import (
 __all__ = [
     "sample_layer",
     "sample_layer_rotation",
+    "sample_layer_window",
     "permute_csr",
     "as_index_rows",
     "as_index_rows_overlapping",

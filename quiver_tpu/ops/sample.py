@@ -192,6 +192,63 @@ def as_index_rows_overlapping(indices: jax.Array,
     return jnp.concatenate([base, nxt], axis=1)        # [rows, 2*width]
 
 
+def _window_layout(indices_rows: jax.Array, stride: int | None, k: int):
+    """Validate a windowed-layout (pair or overlapping) request and
+    return (step, win): flat positions per row step and the assembled
+    window length."""
+    width = indices_rows.shape[1]
+    overlap = stride is not None
+    if overlap and width != 2 * stride:
+        # a mismatched layout would silently gather the wrong CSR rows
+        raise ValueError(
+            f"stride={stride} requires an as_index_rows_overlapping "
+            f"layout of width 2*stride={2 * stride}, got width {width}")
+    step = stride if overlap else width
+    win = 2 * step
+    k_cap = (step + 1) if overlap else width
+    if k > k_cap:
+        raise ValueError(
+            f"windowed sampling supports k <= {k_cap} for this layout "
+            f"(got {k}): the row window only covers that many picks")
+    return step, win
+
+
+def _segment_heads(indptr: jax.Array, seeds: jax.Array):
+    """(valid, start, deg, counts-free) bookkeeping shared by the
+    windowed samplers; -1 seeds get deg 0."""
+    n = indptr.shape[0] - 1
+    valid = seeds >= 0
+    safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
+    start = indptr[safe]
+    deg = jnp.where(valid, indptr[safe + 1] - start, 0).astype(jnp.int32)
+    return valid, start, deg
+
+
+def _gather_window(indices_rows: jax.Array, p0: jax.Array, step: int,
+                   stride: int | None):
+    """Assemble each seed's 2*step-wide window anchored at flat
+    position p0: one gather on the overlapping layout, two on pair."""
+    r0 = (p0 // step).astype(jnp.int32)
+    off = (p0 % step).astype(jnp.int32)
+    if stride is not None:
+        w = indices_rows[r0]                                # [bs, 2*step]
+    else:
+        w = jnp.concatenate(
+            [indices_rows[r0], indices_rows[r0 + 1]], axis=1)
+    return w, r0, off
+
+
+def _extract_window_cols(w: jax.Array, pos: jax.Array, k: int):
+    """nbrs[b, j] = w[b, pos[b, j]] via k onehot passes (TPU per-index
+    gathers are serial; dense compare+select is the fast form)."""
+    wiota = jax.lax.broadcasted_iota(jnp.int32, (1, w.shape[1]), 1)
+    cols = []
+    for j in range(k):
+        onehot = wiota == pos[:, j][:, None]
+        cols.append(jnp.sum(jnp.where(onehot, w, 0), axis=1))
+    return jnp.stack(cols, axis=1).astype(jnp.int32)
+
+
 def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
                           seeds: jax.Array, k: int, key: jax.Array,
                           with_slots: bool = False,
@@ -219,52 +276,71 @@ def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
       gather per seed — half the gather traffic of the default layout,
       for 2x index memory.
     """
-    width = indices_rows.shape[1]
-    overlap = stride is not None
-    if overlap and width != 2 * stride:
-        # a mismatched layout would silently gather the wrong CSR rows
-        raise ValueError(
-            f"stride={stride} requires an as_index_rows_overlapping "
-            f"layout of width 2*stride={2 * stride}, got width {width}")
-    w_eff = (stride + 1) if overlap else width
-    if k > w_eff:
-        raise ValueError(
-            f"sample_layer_rotation supports k <= {w_eff} for this layout "
-            f"(got {k}): the row window only covers picks [off, off+k)")
-    n = indptr.shape[0] - 1
-    valid = seeds >= 0
-    safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
-    start = indptr[safe]
-    deg = jnp.where(valid, indptr[safe + 1] - start, 0).astype(jnp.int32)
+    step, _ = _window_layout(indices_rows, stride, k)
+    valid, start, deg = _segment_heads(indptr, seeds)
     counts = jnp.minimum(deg, k)
 
     bs = seeds.shape[0]
     span = jnp.maximum(deg - k, 0) + 1
     o = jax.random.randint(key, (bs,), 0, span, dtype=jnp.int32)
-    p0 = start + o.astype(start.dtype)
-    if overlap:
-        r0 = (p0 // stride).astype(jnp.int32)
-        off = (p0 % stride).astype(jnp.int32)
-        # one row-gather: the overlapping row always covers [off, off+k)
-        w = indices_rows[r0]                                # [bs, 2*stride]
-    else:
-        r0 = (p0 // width).astype(jnp.int32)
-        off = (p0 % width).astype(jnp.int32)
-        # two row-gathers -> a 2*width window that always covers picks
-        # [off, off + k) since k <= width
-        w = jnp.concatenate(
-            [indices_rows[r0], indices_rows[r0 + 1]], axis=1)
-    wiota = jax.lax.broadcasted_iota(jnp.int32, (1, w.shape[1]), 1)
-    cols = []
-    for j in range(k):
-        onehot = wiota == (off[:, None] + j)
-        cols.append(jnp.sum(jnp.where(onehot, w, 0), axis=1))
-    nbrs = jnp.stack(cols, axis=1).astype(jnp.int32)
+    p0 = start + o.astype(start.dtype)      # window anchored at the pick
+    w, _, off = _gather_window(indices_rows, p0, step, stride)
+    pos = off[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    nbrs = _extract_window_cols(w, pos, k)
     mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
     if with_slots:
         # pick j sits at flat position p0 + j of the (permuted) edge
         # array; map through permute_csr's slot_map for original slots
         slots = p0[:, None] + jnp.arange(k, dtype=p0.dtype)[None, :]
+        return jnp.where(mask, nbrs, -1), counts, jnp.where(mask, slots, -1)
+    return jnp.where(mask, nbrs, -1), counts
+
+
+def sample_layer_window(indptr: jax.Array, indices_rows: jax.Array,
+                        seeds: jax.Array, k: int, key: jax.Array,
+                        with_slots: bool = False,
+                        stride: int | None = None):
+    """Window sampling: an EXACT i.i.d. ``min(deg, k)``-subset drawn
+    uniformly without replacement from the window of the (pre-shuffled)
+    neighbor row that starts at the seed's segment — up to ~2*width
+    entries (>= 129 with the default 128-wide layouts).
+
+    Statistics: for ``deg <= window`` this is exactly the reference
+    reservoir kernel's draw (i.i.d. uniform subsets) under ANY fixed
+    row order. For hub nodes beyond the window, the draw is an i.i.d.
+    subset of the epoch's window subset; the k/deg marginal then holds
+    only in expectation over the ``permute_csr`` shuffle, so hub-heavy
+    graphs REQUIRE the per-epoch reshuffle (without it, hub neighbors
+    outside the fixed window are never sampled — stricter than
+    rotation, whose random offset walks the whole segment every draw).
+    Unlike rotation (consecutive runs), two draws of the same node
+    within one epoch are independent k-subsets of the window.
+
+    Cost: the same one (overlap layout, ``stride=width``) or two (pair
+    layout) row gathers per seed as rotation, plus a [bs, window]
+    uniform draw and top_k — the price of subset independence.
+
+    Returns (neighbors [bs, k] -1 fill, counts [bs]); with
+    ``with_slots``, also the (permuted-array) flat slot of each pick.
+    """
+    step, win = _window_layout(indices_rows, stride, k)
+    valid, start, deg = _segment_heads(indptr, seeds)
+    counts = jnp.minimum(deg, k)
+
+    w, r0, off = _gather_window(indices_rows, start, step, stride)
+    # the window covers neighbor positions [0, cap) of this seed's
+    # segment, cap = min(deg, win - off) >= min(deg, step + 1)
+    cap = jnp.minimum(deg, win - off)                       # [bs]
+    wiota = jax.lax.broadcasted_iota(jnp.int32, (1, win), 1)
+    in_seg = (wiota >= off[:, None]) & (wiota < (off + cap)[:, None])
+    pri = jax.random.uniform(key, (seeds.shape[0], win))
+    pri = jnp.where(in_seg, pri, -1.0)
+    _, picks = jax.lax.top_k(pri, k)                        # [bs, k] window pos
+    nbrs = _extract_window_cols(w, picks, k)
+    mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
+    if with_slots:
+        base = (r0.astype(start.dtype) * step)[:, None]
+        slots = base + picks.astype(start.dtype)
         return jnp.where(mask, nbrs, -1), counts, jnp.where(mask, slots, -1)
     return jnp.where(mask, nbrs, -1), counts
 

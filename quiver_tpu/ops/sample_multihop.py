@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from .sample import (LayerSample, as_index_rows, as_index_rows_overlapping,
                      compact_layer, edge_rows, permute_csr, sample_layer,
-                     sample_layer_rotation)
+                     sample_layer_rotation, sample_layer_window)
 from .weighted import sample_layer_weighted
 
 
@@ -28,15 +28,22 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     sampling order (innermost target hop first).
 
     ``method``: ``"exact"`` (default; i.i.d. Fisher-Yates subsets, k
-    scattered loads per seed) or ``"rotation"`` (~3x faster on TPU: two
-    128-wide row fetches per seed; rotation draws consecutive runs of the
-    row order, so rows must be shuffled with ``permute_csr`` — at least
-    once, ideally per epoch — or endpoint neighbors are under-sampled;
-    pass the shuffled array as ``indices`` and its ``as_index_rows`` view
-    as ``indices_rows``). If ``indices_rows`` is omitted in rotation
-    mode, one ``permute_csr`` is applied internally so the draw is still
-    marginally uniform — correct but slower per call; callers on the hot
-    path should shuffle once per epoch themselves.
+    scattered loads per seed), ``"rotation"`` (~3x faster on TPU: wide
+    row fetches per seed; draws consecutive runs of the row order, so
+    rows must be shuffled with ``permute_csr`` — at least once, ideally
+    per epoch — or endpoint neighbors are under-sampled; pass the
+    shuffled array as ``indices`` and its ``as_index_rows`` view as
+    ``indices_rows``), or ``"window"`` (same row fetches as rotation
+    but an EXACT i.i.d. k-subset of the seed's >=129-entry shuffled
+    window — independent subsets within an epoch, exact for
+    deg <= window; NOTE window mode's anchored window makes the
+    per-epoch reshuffle mandatory on hub-heavy graphs — a hub's
+    neighbors beyond the window are unreachable until the next
+    shuffle, whereas rotation's random offset walks the whole segment
+    every draw). If ``indices_rows`` is omitted in rotation/window
+    mode, one ``permute_csr`` is applied internally so the draw is
+    still marginally uniform — correct but slower per call; callers on
+    the hot path should shuffle per epoch themselves.
     ``edge_weight`` (CSR-slot-aligned) switches every hop to weighted
     sampling (always exact).
 
@@ -54,7 +61,8 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     """
     cur = seeds.astype(jnp.int32)
     track_eid = eid is not None
-    if edge_weight is None and method == "rotation" and indices_rows is None:
+    windowed = method in ("rotation", "window")
+    if edge_weight is None and windowed and indices_rows is None:
         # the no-arg fallback must not sample consecutive runs of the
         # caller's (possibly raw CSR) order — that permanently
         # under-samples row-endpoint neighbors
@@ -83,6 +91,10 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
             out = sample_layer_rotation(indptr, indices_rows, cur, k, sub,
                                         with_slots=track_eid,
                                         stride=indices_stride)
+        elif method == "window":
+            out = sample_layer_window(indptr, indices_rows, cur, k, sub,
+                                      with_slots=track_eid,
+                                      stride=indices_stride)
         else:
             out = sample_layer(indptr, indices, cur, k, sub,
                                with_slots=track_eid)

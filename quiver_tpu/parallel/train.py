@@ -136,7 +136,7 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
         return TrainState(params, opt_state, state.step + 1), loss
 
     specs = [P(), P(), P(), P(), P(), P(axis), P(axis), P()]
-    if method == "rotation":
+    if method in ("rotation", "window"):
         specs.append(P())   # indices_rows, replicated
     mapped = shard_map(
         per_shard, mesh=mesh,
@@ -150,11 +150,12 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     # opaque shard_map/jit arity failure
     def step(state, feat, forder, indptr, indices, seeds, labels, key,
              indices_rows=None):
-        if method == "rotation":
+        if method in ("rotation", "window"):
             if indices_rows is None:
                 raise TypeError(
-                    "rotation e2e step requires indices_rows (the shuffled "
-                    "as_index_rows view; refresh per epoch via permute_csr)")
+                    f"{method} e2e step requires indices_rows (the "
+                    "shuffled as_index_rows view; refresh per epoch via "
+                    "permute_csr)")
             return jitted(state, feat, forder, indptr, indices, seeds,
                           labels, key, indices_rows)
         if indices_rows is not None:

@@ -111,17 +111,22 @@ class GraphSageSampler:
         self.edge_weight = edge_weight
         if edge_weight is not None and mode == "CPU":
             raise ValueError("weighted sampling runs on the device path")
-        # sampling="rotation": ~3x faster device path (two 128-wide row
-        # fetches per seed over a shuffled CSR copy instead of k scattered
-        # loads). The sampler shuffles once at init; call reshuffle() at
-        # each epoch boundary so draws stay marginally uniform.
-        if sampling not in ("exact", "rotation"):
+        # sampling="rotation": ~3x faster device path (wide row fetches
+        # per seed over a shuffled CSR copy instead of k scattered
+        # loads); "window" costs the same fetches but draws exact i.i.d.
+        # k-subsets of each seed's >=129-entry shuffled window (subset-
+        # independent within an epoch, exact for deg <= window). Both
+        # shuffle once at init; call reshuffle() at each epoch boundary
+        # so draws stay marginally uniform.
+        if sampling not in ("exact", "rotation", "window"):
             raise ValueError(f"unknown sampling method {sampling!r}")
-        if sampling == "rotation" and (
+        if sampling in ("rotation", "window") and (
                 edge_weight is not None or mode == "CPU"):
             sampling = "exact"   # those paths have their own samplers
-        if sampling == "rotation" and max(sizes, default=0) > 128:
-            raise ValueError("rotation sampling supports fanouts <= 128")
+        if sampling in ("rotation", "window") and \
+                max(sizes, default=0) > 128:
+            raise ValueError(
+                f"{sampling} sampling supports fanouts <= 128")
         # with_eid: stamp every sampled edge with its global edge id
         # (CSRTopo.eid -> original COO position; CSR slot if no eid map),
         # delivered in Adj.e_id. Costs one scattered gather per edge, so
@@ -216,9 +221,9 @@ class GraphSageSampler:
         method = self.sampling
         eid_mode = "none"
         if self.with_eid:
-            # rotation always needs the co-permuted map; otherwise the
-            # topo's eid map if present, else raw CSR slots
-            eid_mode = ("map" if (method == "rotation"
+            # rotation/window always need the co-permuted map; otherwise
+            # the topo's eid map if present, else raw CSR slots
+            eid_mode = ("map" if (method in ("rotation", "window")
                                   or self.csr_topo.eid is not None)
                         else "slots")
 
@@ -256,7 +261,7 @@ class GraphSageSampler:
         fn = self._fn_for(bs)
         if self.edge_weight is not None and self._weight_placed is None:
             self._weight_placed = jnp.asarray(self.edge_weight)
-        if self.sampling == "rotation":
+        if self.sampling in ("rotation", "window"):
             if self._rot is None:
                 self.reshuffle()
             rows = self._rot

@@ -216,6 +216,110 @@ class TestRotationSampler:
             np.testing.assert_array_equal(np.asarray(A.col),
                                           np.asarray(B.col))
 
+    def test_window_membership_counts_distinct(self, small_graph):
+        from quiver_tpu.ops import as_index_rows, sample_layer_window
+        indptr, indices = small_graph
+        nsets = neighbor_sets(indptr, indices)
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        k = 5
+        rows = as_index_rows(jnp.asarray(indices))
+        nbrs, counts = sample_layer_window(
+            jnp.asarray(indptr), rows, jnp.asarray(seeds), k, KEY)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        deg = np.diff(indptr)
+        np.testing.assert_array_equal(counts, np.minimum(deg, k))
+        for i, v in enumerate(seeds):
+            got = nbrs[i][: counts[i]]
+            assert set(got.tolist()) <= nsets[v]
+            assert (nbrs[i][counts[i]:] == -1).all()
+
+    def test_window_exact_uniform_without_reshuffle(self):
+        # for deg <= window the draw is an exact uniform k-subset of the
+        # full neighbor list under ANY fixed order — uniformity must
+        # hold with NO re-shuffling (rotation needs reshuffles for this)
+        from quiver_tpu.ops import as_index_rows, sample_layer_window
+        indptr = np.array([0, 10])
+        indices = np.arange(100, 110)
+        rows = as_index_rows(jnp.asarray(indices))
+        seeds = jnp.zeros((64,), jnp.int32)
+        hits = np.zeros(10)
+        for t in range(40):
+            nbrs, _ = sample_layer_window(
+                jnp.asarray(indptr), rows, seeds, 2,
+                jax.random.fold_in(KEY, t))
+            ids, cnt = np.unique(np.asarray(nbrs) - 100, return_counts=True)
+            hits[ids] += cnt
+        freq = hits / hits.sum()
+        np.testing.assert_allclose(freq, 0.1, atol=0.02)
+
+    def test_window_draws_independent_within_epoch(self):
+        # two draws of the same node with different keys (same epoch,
+        # same fixed order) must not be forced into consecutive runs:
+        # collect many 2-subsets of a 12-neighbor node and check far
+        # more distinct subsets appear than rotation's 11 runs allow
+        from quiver_tpu.ops import as_index_rows, sample_layer_window
+        deg = 12
+        indptr = np.array([0, deg])
+        indices = np.arange(200, 200 + deg)
+        rows = as_index_rows(jnp.asarray(indices))
+        seeds = jnp.zeros((1,), jnp.int32)
+        subsets = set()
+        for t in range(80):
+            nbrs, _ = sample_layer_window(
+                jnp.asarray(indptr), rows, seeds, 2,
+                jax.random.fold_in(KEY, 500 + t))
+            subsets.add(tuple(sorted(np.asarray(nbrs)[0].tolist())))
+        # C(12,2) = 66 possible; rotation could produce at most 11
+        assert len(subsets) > 25
+
+    def test_window_overlap_layout_identical(self, small_graph):
+        from quiver_tpu.ops import (as_index_rows,
+                                    as_index_rows_overlapping,
+                                    sample_layer_window)
+        indptr, indices = small_graph
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        pair = as_index_rows(jnp.asarray(indices))
+        over = as_index_rows_overlapping(jnp.asarray(indices))
+        a, ca, sa = sample_layer_window(
+            jnp.asarray(indptr), pair, jnp.asarray(seeds), 4, KEY,
+            with_slots=True)
+        b, cb, sb = sample_layer_window(
+            jnp.asarray(indptr), over, jnp.asarray(seeds), 4, KEY,
+            with_slots=True, stride=128)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+
+    def test_window_hub_truncation_still_members(self):
+        # deg 500 hub: picks come from the anchored window only, but
+        # must still be real neighbors with k distinct slots
+        from quiver_tpu.ops import as_index_rows, sample_layer_window
+        deg = 500
+        indptr = np.array([0, deg])
+        indices = np.arange(1000, 1000 + deg)
+        rows = as_index_rows(jnp.asarray(indices))
+        nbrs, counts, slots = sample_layer_window(
+            jnp.asarray(indptr), rows, jnp.zeros((8,), jnp.int32), 6, KEY,
+            with_slots=True)
+        nbrs, slots = np.asarray(nbrs), np.asarray(slots)
+        assert (np.asarray(counts) == 6).all()
+        for i in range(8):
+            assert ((nbrs[i] >= 1000) & (nbrs[i] < 1500)).all()
+            assert len(set(slots[i].tolist())) == 6
+            np.testing.assert_array_equal(indices[slots[i]], nbrs[i])
+
+    def test_window_masked_and_zero_degree(self):
+        from quiver_tpu.ops import as_index_rows, sample_layer_window
+        indptr = np.array([0, 0, 2, 2])
+        indices = np.array([5, 6])
+        rows = as_index_rows(jnp.asarray(indices))
+        nbrs, counts = sample_layer_window(
+            jnp.asarray(indptr), rows, jnp.array([0, 1, -1], jnp.int32), 3,
+            KEY)
+        counts = np.asarray(counts)
+        assert counts.tolist() == [0, 2, 0]
+        assert set(np.asarray(nbrs)[1][:2].tolist()) == {5, 6}
+
     def test_stride_layout_mismatch_raises(self, small_graph):
         # a stride that doesn't match the layout width must error, not
         # silently gather the wrong CSR rows

@@ -92,6 +92,16 @@ class TestGraphSageSamplerHBM:
         with pytest.raises(ValueError):
             qv.GraphSageSampler(topo, [200], sampling="rotation")
 
+    def test_window_sampling_end_to_end(self, topo, rng):
+        sampler = qv.GraphSageSampler(topo, sizes=[5, 3], mode="HBM",
+                                      sampling="window")
+        seeds = rng.choice(topo.node_count, 32, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_sample_output(topo, seeds, n_id, bs, adjs, [5, 3])
+        sampler.reshuffle()          # epoch boundary
+        n_id2, _, adjs2 = sampler.sample(seeds)
+        check_sample_output(topo, seeds, n_id2, bs, adjs2, [5, 3])
+
 
 def _coo_graph(rng, n=120, e=900):
     coo = rng.integers(0, n, (2, e))
@@ -132,6 +142,17 @@ class TestEdgeIdTracking:
         coo, topo = _coo_graph(rng)
         sampler = qv.GraphSageSampler(topo, sizes=[4, 3],
                                       sampling="rotation", with_eid=True)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_eids(coo, n_id, adjs)
+        sampler.reshuffle()
+        n_id, bs, adjs = sampler.sample(seeds)
+        check_eids(coo, n_id, adjs)
+
+    def test_window_mode_eids_survive_reshuffle(self, rng):
+        coo, topo = _coo_graph(rng)
+        sampler = qv.GraphSageSampler(topo, sizes=[4, 3],
+                                      sampling="window", with_eid=True)
         seeds = rng.choice(topo.node_count, 16, replace=False)
         n_id, bs, adjs = sampler.sample(seeds)
         check_eids(coo, n_id, adjs)
