@@ -214,14 +214,14 @@ def _window_layout(indices_rows: jax.Array, stride: int | None, k: int):
 
 
 def _segment_heads(indptr: jax.Array, seeds: jax.Array):
-    """(valid, start, deg, counts-free) bookkeeping shared by the
-    windowed samplers; -1 seeds get deg 0."""
+    """Per-seed (start, deg) shared by the windowed samplers; invalid
+    (-1) seeds get deg 0, which masks them downstream."""
     n = indptr.shape[0] - 1
     valid = seeds >= 0
     safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
     start = indptr[safe]
     deg = jnp.where(valid, indptr[safe + 1] - start, 0).astype(jnp.int32)
-    return valid, start, deg
+    return start, deg
 
 
 def _gather_window(indices_rows: jax.Array, p0: jax.Array, step: int,
@@ -277,7 +277,7 @@ def sample_layer_rotation(indptr: jax.Array, indices_rows: jax.Array,
       for 2x index memory.
     """
     step, _ = _window_layout(indices_rows, stride, k)
-    valid, start, deg = _segment_heads(indptr, seeds)
+    start, deg = _segment_heads(indptr, seeds)
     counts = jnp.minimum(deg, k)
 
     bs = seeds.shape[0]
@@ -324,7 +324,7 @@ def sample_layer_window(indptr: jax.Array, indices_rows: jax.Array,
     ``with_slots``, also the (permuted-array) flat slot of each pick.
     """
     step, win = _window_layout(indices_rows, stride, k)
-    valid, start, deg = _segment_heads(indptr, seeds)
+    start, deg = _segment_heads(indptr, seeds)
     counts = jnp.minimum(deg, k)
 
     w, r0, off = _gather_window(indices_rows, start, step, stride)
