@@ -111,6 +111,57 @@ def build_exchange_fn(mesh: Mesh, axis: str, rows_per_host: int, cap: int,
     return jax.jit(mapped)
 
 
+def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
+                      feat: jax.Array, axis: str, h_count: int,
+                      rows_per_host: int, dtype=jnp.float32, rep=None):
+    """The per-shard body of the fused DistFeature lookup — callable from
+    INSIDE any ``shard_map`` over ``axis`` (e.g. the multi-host fused
+    train step composes it with sampling and the model step):
+
+      ids  [B] this shard's global node ids, -1 fill
+      g2h/loc [N] replicated owner / local-row maps
+      feat [rows_per_host, dim] this shard's rows
+      -> [B, dim] feature rows (zeros at -1 fill)
+
+    Bucket ids by owner (one-hot + cumsum), scatter into a [H, B]
+    request block, one ``all_to_all`` ships requests, a local gather
+    reads rows, a second ``all_to_all`` ships responses, and a final
+    gather unbuckets them into batch order. ``rep`` optionally carries
+    (is_rep [N], rep_rank [N], bases [H]) for replicated-node
+    resolution against the calling host's replica tail."""
+    batch = ids.shape[0]
+    valid = ids >= 0
+    safe = jnp.clip(ids, 0)
+    owner = jnp.where(valid, g2h[safe], -1)                 # [B]
+    local = loc[safe]                                       # [B]
+    if rep:
+        # replicated nodes resolve locally: owner := this host,
+        # local := this host's replica-tail base + rank in the set
+        is_rep, rep_rank, bases = rep
+        me = jax.lax.axis_index(axis).astype(owner.dtype)
+        r = is_rep[safe]
+        owner = jnp.where(valid & r, me, owner)
+        local = jnp.where(r, bases[me] + rep_rank[safe], local)
+    onehot = owner[None, :] == jnp.arange(
+        h_count, dtype=owner.dtype)[:, None]                # [H, B]
+    bucket_pos = jnp.cumsum(onehot, axis=1) - 1             # [H, B]
+    my_pos = jnp.sum(jnp.where(onehot, bucket_pos, 0), axis=0)  # [B]
+    # invalid (-1 fill) entries must route to a POSITIVELY
+    # out-of-bounds row: `.at[...].set(mode="drop")` resolves negative
+    # indices NumPy-style BEFORE the bounds check, so owner=-1 would
+    # silently overwrite host H-1's bucket slot 0
+    owner_idx = jnp.where(valid, owner, h_count)
+    req = jnp.zeros((h_count, batch), jnp.int32).at[
+        owner_idx, my_pos].set(local, mode="drop")
+    incoming = jax.lax.all_to_all(
+        req, axis, split_axis=0, concat_axis=0)             # [H, B]
+    rows = feat[jnp.clip(incoming, 0, rows_per_host - 1)]   # [H, B, d]
+    resp = jax.lax.all_to_all(
+        rows, axis, split_axis=0, concat_axis=0)            # [H, B, d]
+    out = resp[jnp.clip(owner, 0), my_pos]                  # [B, d]
+    return jnp.where(valid[:, None], out, 0).astype(dtype)
+
+
 def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
                          batch_per_host: int, dim: int, dtype=jnp.float32,
                          with_replicate: bool = False):
@@ -137,37 +188,9 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
     h_count = mesh.shape[axis]
 
     def body(ids, g2h, loc, feat, *rep):
-        ids = ids.reshape(-1)                                   # [B]
-        valid = ids >= 0
-        safe = jnp.clip(ids, 0)
-        owner = jnp.where(valid, g2h[safe], -1)                 # [B]
-        local = loc[safe]                                       # [B]
-        if rep:
-            # replicated nodes resolve locally: owner := this host,
-            # local := this host's replica-tail base + rank in the set
-            is_rep, rep_rank, bases = rep
-            me = jax.lax.axis_index(axis).astype(owner.dtype)
-            r = is_rep[safe]
-            owner = jnp.where(valid & r, me, owner)
-            local = jnp.where(r, bases[me] + rep_rank[safe], local)
-        onehot = owner[None, :] == jnp.arange(
-            h_count, dtype=owner.dtype)[:, None]                # [H, B]
-        bucket_pos = jnp.cumsum(onehot, axis=1) - 1             # [H, B]
-        my_pos = jnp.sum(jnp.where(onehot, bucket_pos, 0), axis=0)  # [B]
-        # invalid (-1 fill) entries must route to a POSITIVELY
-        # out-of-bounds row: `.at[...].set(mode="drop")` resolves negative
-        # indices NumPy-style BEFORE the bounds check, so owner=-1 would
-        # silently overwrite host H-1's bucket slot 0
-        owner_idx = jnp.where(valid, owner, h_count)
-        req = jnp.zeros((h_count, batch_per_host), jnp.int32).at[
-            owner_idx, my_pos].set(local, mode="drop")
-        incoming = jax.lax.all_to_all(
-            req, axis, split_axis=0, concat_axis=0)             # [H, B]
-        rows = feat[jnp.clip(incoming, 0, rows_per_host - 1)]   # [H, B, d]
-        resp = jax.lax.all_to_all(
-            rows, axis, split_axis=0, concat_axis=0)            # [H, B, d]
-        out = resp[jnp.clip(owner, 0), my_pos]                  # [B, d]
-        return jnp.where(valid[:, None], out, 0).astype(dtype)
+        return dist_lookup_local(ids.reshape(-1), g2h, loc, feat, axis,
+                                 h_count, rows_per_host, dtype,
+                                 rep=rep or None)
 
     specs = (P(axis), P(), P(), P(axis))
     if with_replicate:

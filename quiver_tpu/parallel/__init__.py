@@ -6,6 +6,7 @@ from .train import (
     cross_entropy_logits,
 )
 from .gspmd import build_gspmd_train_step, shard_state, state_sharding
+from .dist import build_dist_train_step
 
 __all__ = [
     "make_mesh",
@@ -15,6 +16,7 @@ __all__ = [
     "build_train_step",
     "build_e2e_train_step",
     "build_gspmd_train_step",
+    "build_dist_train_step",
     "shard_state",
     "state_sharding",
     "cross_entropy_logits",

@@ -1,0 +1,122 @@
+"""Multi-host fused training: sample + distributed feature exchange +
+forward/backward + update as ONE XLA program.
+
+The TPU answer to the reference's multi-node training benchmark
+(benchmarks/ogbn-papers100M/train_quiver_multi_node.py:270-411: per-rank
+DDP processes, DistFeature lookups through the hand-scheduled NCCL
+exchange, TCPStore bootstrap). Here every host's shard, inside a single
+``shard_map`` over the ``host`` axis:
+
+  1. samples its own seed shard's k-hop frontier (topology replicated),
+  2. fetches the frontier's feature rows from whichever hosts own them —
+     the fused dispatch + ``all_to_all`` exchange + scatter of
+     ``comm.dist_lookup_local`` (features stay partitioned, nothing is
+     ever all-gathered),
+  3. runs forward/backward and ``pmean``s gradients.
+
+One jit, zero host round trips, no bootstrap beyond
+``jax.distributed.initialize``; the same program runs on the virtual
+CPU mesh, a TPU slice (ICI), or multi-slice (DCN). The loss definition
+is literally the shared ``_fused_loss`` with the feature gather swapped
+for the partitioned exchange, so dist/DP loss parity holds exactly
+(tests/test_dist_train.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..comm import dist_lookup_local
+from .train import (TrainState, _fused_loss, _pmean_update,
+                    cross_entropy_logits)
+
+
+def build_dist_train_step(model, tx, sizes: Sequence[int],
+                          per_host_batch: int, mesh: Mesh,
+                          rows_per_host: int,
+                          axis: str = "host",
+                          loss_fn: Callable = cross_entropy_logits,
+                          method: str = "exact",
+                          indices_stride: int | None = None,
+                          with_replicate: bool = False):
+    """fn(state, spmd_feat, g2h, g2l, indptr, indices, seeds, labels,
+    key[, indices_rows][, is_rep, rep_rank, bases]) -> (state, loss).
+
+    ``spmd_feat`` [H*rows_per_host, dim] is the partition-sharded store
+    (``DistFeature.from_partition``'s layout — pass ``dist._spmd_feat``);
+    ``g2h``/``g2l`` the replicated owner / local-row maps
+    (``PartitionInfo.global2host/global2local``); ``seeds``/``labels``
+    [H*per_host_batch] sharded over ``axis``; topology replicated.
+
+    ``method="rotation"|"window"`` requires the shuffled
+    ``indices_rows`` view (refresh per epoch; ``indices_stride=128``
+    for the overlapping layout). ``with_replicate=True`` adds the three
+    replicated-node operands (``DistFeature._rep_args``) so replicated
+    nodes resolve against the calling host's replica tail instead of
+    being mis-routed to their owner with a tail-local index.
+    """
+    sizes = list(sizes)
+    h_count = mesh.shape[axis]
+    windowed = method in ("rotation", "window")
+
+    def per_shard(state: TrainState, feat, g2h, g2l, indptr, indices,
+                  seeds, labels, key, *extra):
+        rows = extra[0] if windowed else None
+        rep = extra[1:] if (windowed and with_replicate) else \
+            (extra if with_replicate else None)
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+
+        def gather(feat_, n_id, _forder):
+            return dist_lookup_local(n_id, g2h, g2l, feat_, axis, h_count,
+                                     rows_per_host, dtype=feat_.dtype,
+                                     rep=rep or None)
+
+        loss, grads = jax.value_and_grad(
+            lambda p: _fused_loss(model, loss_fn, sizes, per_host_batch,
+                                  p, feat, None, indptr, indices, seeds,
+                                  labels, key, method, rows,
+                                  indices_stride, gather=gather)
+        )(state.params)
+        return _pmean_update(state, tx, grads, loss, axis)
+
+    specs = [P(), P(axis), P(), P(), P(), P(), P(axis), P(axis), P()]
+    if windowed:
+        specs.append(P())            # indices_rows, replicated
+    if with_replicate:
+        specs += [P(), P(), P()]     # is_rep, rep_rank, bases
+    mapped = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=tuple(specs),
+        out_specs=(P(), P()),
+        check_vma=False)
+    jitted = jax.jit(mapped)
+
+    def step(state, feat, g2h, g2l, indptr, indices, seeds, labels, key,
+             indices_rows=None, rep_args=()):
+        extra = ()
+        if windowed:
+            if indices_rows is None:
+                raise TypeError(
+                    f"{method} dist step requires indices_rows (the "
+                    "shuffled view; refresh per epoch via permute_csr)")
+            extra += (indices_rows,)
+        elif indices_rows is not None:
+            raise TypeError(
+                f"method={method!r} dist step takes no indices_rows")
+        if with_replicate:
+            if len(rep_args) != 3:
+                raise TypeError(
+                    "with_replicate dist step requires rep_args = "
+                    "(is_rep, rep_rank, bases) — pass "
+                    "DistFeature._rep_args")
+            extra += tuple(rep_args)
+        elif rep_args:
+            raise TypeError("rep_args given but with_replicate=False")
+        return jitted(state, feat, g2h, g2l, indptr, indices, seeds,
+                      labels, key, *extra)
+
+    return step

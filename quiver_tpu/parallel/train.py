@@ -66,15 +66,30 @@ def masked_feature_gather(feat: jax.Array, n_id: jax.Array,
 
 def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
                 indptr, indices, seeds, labels, key, method="exact",
-                indices_rows=None, indices_stride=None):
+                indices_rows=None, indices_stride=None, gather=None):
+    """``gather(feat, n_id, forder)`` defaults to the local
+    ``masked_feature_gather``; the multi-host fused step substitutes the
+    partitioned all_to_all lookup. Everything else (sampling keys, the
+    dropout fold constant, the logits slice) is THE shared definition —
+    dist/DP loss parity depends on there being exactly one copy."""
     n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
                                    method=method, indices_rows=indices_rows,
                                    indices_stride=indices_stride)
-    x = masked_feature_gather(feat, n_id, forder)
+    x = (gather or masked_feature_gather)(feat, n_id, forder)
     adjs = layers_to_adjs(layers, batch_size, sizes)
     logits = model.apply(params, x, adjs, train=True,
                          rngs={"dropout": jax.random.fold_in(key, 1000)})
     return loss_fn(logits[:batch_size], labels)
+
+
+def _pmean_update(state, tx, grads, loss, axis):
+    """Cross-shard gradient/loss reduction + optimizer update (shared by
+    the shard_map builders)."""
+    grads = jax.lax.pmean(grads, axis)
+    loss = jax.lax.pmean(loss, axis)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss
 
 
 def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
@@ -129,11 +144,7 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                                   labels, key, method, indices_rows,
                                   indices_stride)
         )(state.params)
-        grads = jax.lax.pmean(grads, axis)
-        loss = jax.lax.pmean(loss, axis)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
+        return _pmean_update(state, tx, grads, loss, axis)
 
     specs = [P(), P(), P(), P(), P(), P(axis), P(axis), P()]
     if method in ("rotation", "window"):

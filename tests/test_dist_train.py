@@ -1,0 +1,203 @@
+"""Multi-host fused train step: sample + distributed feature exchange +
+train as one shard_map program, on the virtual 8-host mesh.
+
+The key equivalence: with the same state/seeds/keys, the dist step must
+produce EXACTLY the loss of the plain data-parallel step — the only
+difference is that features arrive via the partitioned all_to_all
+exchange instead of a replicated-array gather."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import quiver_tpu as qv
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops import sample_multihop
+from quiver_tpu.parallel import (build_dist_train_step,
+                                 build_e2e_train_step)
+from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                       masked_feature_gather)
+
+
+@pytest.fixture
+def setup(rng):
+    n, dim, classes, hosts = 240, 12, 4, 8
+    deg = rng.integers(1, 9, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    g2h = rng.integers(0, hosts, n).astype(np.int32)
+    g2h[:hosts] = np.arange(hosts)        # every host owns something
+
+    mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+    info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+    comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh, axis="host")
+    dist = qv.DistFeature.from_partition(feat, info, comm)
+
+    sizes, per_host = [3, 2], 8
+    model = GraphSAGE(hidden_dim=16, out_dim=classes, num_layers=2,
+                      dropout=0.0)
+    tx = optax.adam(1e-2)
+    indptr_j = jnp.asarray(indptr.astype(np.int32))
+    indices_j = jnp.asarray(indices)
+    n_id, layers = sample_multihop(indptr_j, indices_j,
+                                   jnp.arange(per_host, dtype=jnp.int32),
+                                   sizes, jax.random.key(0))
+    state = init_state(model, tx,
+                       masked_feature_gather(jnp.asarray(feat), n_id),
+                       layers_to_adjs(layers, per_host, sizes),
+                       jax.random.key(1))
+    return (mesh, info, dist, model, tx, sizes, per_host, indptr_j,
+            indices_j, jnp.asarray(feat), jnp.asarray(labels), state,
+            hosts)
+
+
+class TestDistTrainStep:
+    def test_matches_data_parallel_step(self, setup, rng):
+        (mesh, info, dist, model, tx, sizes, per_host, indptr, indices,
+         feat, labels, state, hosts) = setup
+        g = hosts * per_host
+        seeds = jnp.asarray(
+            rng.choice(240, g, replace=False).astype(np.int32))
+        y = labels[seeds]
+        key = jax.random.key(11)
+        sharding = NamedSharding(mesh, P("host"))
+        seeds_s = jax.device_put(seeds, sharding)
+        y_s = jax.device_put(y, sharding)
+
+        dp_step = build_e2e_train_step(model, tx, sizes, per_host, mesh,
+                                       axis="host")
+        dp_state, dp_loss = dp_step(state, feat, None, indptr, indices,
+                                    seeds_s, y_s, key)
+
+        dist_step = build_dist_train_step(
+            model, tx, sizes, per_host, mesh,
+            rows_per_host=dist._rows_per_host)
+        d_state, d_loss = dist_step(
+            state, dist._spmd_feat, info.global2host.astype(jnp.int32),
+            info.global2local, indptr, indices, seeds_s, y_s, key)
+
+        np.testing.assert_allclose(float(d_loss), float(dp_loss),
+                                   rtol=1e-5)
+        a = np.asarray(
+            dp_state.params["params"]["conv0"]["lin_nbr"]["kernel"])
+        b = np.asarray(
+            d_state.params["params"]["conv0"]["lin_nbr"]["kernel"])
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-6)
+
+    def test_rotation_mode_matches_dp(self, setup, rng):
+        (mesh, info, dist, model, tx, sizes, per_host, indptr, indices,
+         feat, labels, state, hosts) = setup
+        from quiver_tpu.ops import (as_index_rows, edge_row_ids,
+                                    permute_csr)
+        g = hosts * per_host
+        rids = edge_row_ids(indptr, int(indices.shape[0]))
+        rows = as_index_rows(permute_csr(indices, rids,
+                                         jax.random.key(3)))
+        seeds = jnp.asarray(
+            rng.choice(240, g, replace=False).astype(np.int32))
+        y = labels[seeds]
+        key = jax.random.key(21)
+        sharding = NamedSharding(mesh, P("host"))
+        seeds_s = jax.device_put(seeds, sharding)
+        y_s = jax.device_put(y, sharding)
+
+        dp_step = build_e2e_train_step(model, tx, sizes, per_host, mesh,
+                                       axis="host", method="rotation")
+        _, dp_loss = dp_step(state, feat, None, indptr, indices, seeds_s,
+                             y_s, key, rows)
+        dist_step = build_dist_train_step(
+            model, tx, sizes, per_host, mesh,
+            rows_per_host=dist._rows_per_host, method="rotation")
+        _, d_loss = dist_step(
+            state, dist._spmd_feat, info.global2host.astype(jnp.int32),
+            info.global2local, indptr, indices, seeds_s, y_s, key,
+            indices_rows=rows)
+        np.testing.assert_allclose(float(d_loss), float(dp_loss),
+                                   rtol=1e-5)
+
+    def test_replicated_nodes_resolve_correctly(self, rng):
+        # hot nodes replicated on every host must come back with the
+        # right features through the fused step's gather (regression:
+        # without the rep plumbing they were mis-routed to their owner
+        # with a replica-tail-local index)
+        n, dim, classes, hosts = 160, 8, 4, 8
+        deg = rng.integers(1, 7, n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        labels = rng.integers(0, classes, n).astype(np.int32)
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        rep = np.array([3, 77, 140], np.int32)
+
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h,
+                                replicate=rep)
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        dist = qv.DistFeature.from_partition(feat, info, comm)
+
+        sizes, per_host = [3, 2], 6
+        model = GraphSAGE(hidden_dim=16, out_dim=classes, num_layers=2,
+                          dropout=0.0)
+        tx = optax.adam(1e-2)
+        indptr_j = jnp.asarray(indptr.astype(np.int32))
+        indices_j = jnp.asarray(indices)
+        n_id, layers = sample_multihop(
+            indptr_j, indices_j, jnp.arange(per_host, dtype=jnp.int32),
+            sizes, jax.random.key(0))
+        state = init_state(model, tx,
+                           masked_feature_gather(jnp.asarray(feat), n_id),
+                           layers_to_adjs(layers, per_host, sizes),
+                           jax.random.key(1))
+
+        g = hosts * per_host
+        # seed batches heavy on the replicated ids
+        seeds = np.tile(rep, g // 3 + 1)[:g].astype(np.int32)
+        seeds[1::2] = rng.choice(n, g // 2, replace=False)
+        sharding = NamedSharding(mesh, P("host"))
+        seeds_s = jax.device_put(jnp.asarray(seeds), sharding)
+        y_s = jax.device_put(jnp.asarray(labels[seeds]), sharding)
+        key = jax.random.key(33)
+
+        dp_step = build_e2e_train_step(model, tx, sizes, per_host, mesh,
+                                       axis="host")
+        _, dp_loss = dp_step(state, jnp.asarray(feat), None, indptr_j,
+                             indices_j, seeds_s, y_s, key)
+        dist_step = build_dist_train_step(
+            model, tx, sizes, per_host, mesh,
+            rows_per_host=dist._rows_per_host, with_replicate=True)
+        _, d_loss = dist_step(
+            state, dist._spmd_feat, info.global2host.astype(jnp.int32),
+            info.global2local, indptr_j, indices_j, seeds_s, y_s, key,
+            rep_args=dist._rep_args)
+        np.testing.assert_allclose(float(d_loss), float(dp_loss),
+                                   rtol=1e-5)
+
+    def test_trains(self, setup, rng):
+        (mesh, info, dist, model, tx, sizes, per_host, indptr, indices,
+         feat, labels, state, hosts) = setup
+        g = hosts * per_host
+        step = build_dist_train_step(
+            model, tx, sizes, per_host, mesh,
+            rows_per_host=dist._rows_per_host)
+        sharding = NamedSharding(mesh, P("host"))
+        losses = []
+        for it in range(15):
+            seeds = jax.device_put(jnp.asarray(
+                rng.integers(0, 240, g, dtype=np.int32)), sharding)
+            y = jax.device_put(labels[seeds], sharding)
+            state, loss = step(
+                state, dist._spmd_feat,
+                info.global2host.astype(jnp.int32), info.global2local,
+                indptr, indices, seeds, y,
+                jax.random.fold_in(jax.random.key(5), it))
+            losses.append(float(loss))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
