@@ -1,0 +1,124 @@
+"""Multi-host training with partitioned features — the full loop.
+
+The counterpart of the reference's multi-node benchmark
+(benchmarks/ogbn-papers100M/train_quiver_multi_node.py: per-rank DDP +
+NCCL DistFeature): probability-partition the features across 8 virtual
+hosts, then train GraphSAGE where EVERY step is one shard_map program —
+per-host sampling, fused all_to_all feature exchange (features never
+leave their owning host except as responses), fwd/bwd, pmean'd grads.
+The same program runs unchanged on a real multi-host TPU pod.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     JAX_PLATFORMS=cpu python examples/dist_train_demo.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from quiver_tpu import CSRTopo, DistFeature, PartitionInfo, TpuComm
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops import sample_multihop, sample_prob
+    from quiver_tpu.parallel import build_dist_train_step
+    from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                           masked_feature_gather)
+    from quiver_tpu.partition import partition_feature_without_replication
+
+    devs = jax.devices()
+    hosts = len(devs)
+    mesh = Mesh(np.array(devs), axis_names=("host",))
+    print(f"mesh: {hosts} hosts ({devs[0].platform})")
+
+    # ---- planted-partition graph (learnable labels) ------------------------
+    rng = np.random.default_rng(0)
+    n, dim, classes = 24_000, 64, 8
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    deg = np.maximum(rng.poisson(10, n), 1).astype(np.int64)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    e = int(indptr[-1])
+    same = rng.random(e) < 0.8
+    row = np.repeat(np.arange(n), deg)
+    indices = rng.integers(0, n, e).astype(np.int32)
+    for c in range(classes):
+        pool = np.flatnonzero(labels == c)
+        m = same & (labels[row] == c)
+        indices[m] = pool[rng.integers(0, pool.size, int(m.sum()))]
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    centers = rng.standard_normal((classes, dim)).astype(np.float32)
+    feat = 0.3 * centers[labels] + rng.standard_normal(
+        (n, dim)).astype(np.float32)
+    train_idx = rng.choice(n, n // 5, replace=False).astype(np.int32)
+
+    # ---- probability-driven partition across hosts -------------------------
+    sizes = [10, 5]
+    probs = sample_prob(jnp.asarray(topo.indptr), jnp.asarray(topo.indices),
+                        jnp.asarray(train_idx), sizes, n)
+    parts, _ = partition_feature_without_replication(
+        [np.asarray(probs)] * hosts, chunk_size=256)
+    g2h = np.zeros(n, np.int32)
+    for h, part in enumerate(parts):
+        g2h[np.asarray(part)] = h
+    info = PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+    comm = TpuComm(rank=0, world_size=hosts, mesh=mesh, axis="host")
+    dist = DistFeature.from_partition(feat, info, comm)
+    print(f"features partitioned: {[int(s) for s in info.local_sizes]} "
+          "rows per host")
+
+    # ---- model + the ONE-program multi-host step ---------------------------
+    per_host = 128
+    model = GraphSAGE(hidden_dim=128, out_dim=classes, num_layers=len(sizes),
+                      dropout=0.0)
+    tx = optax.adam(3e-3)
+    indptr_j = jnp.asarray(np.asarray(topo.indptr, np.int32))
+    indices_j = jnp.asarray(topo.indices)
+    n_id, layers = sample_multihop(indptr_j, indices_j,
+                                   jnp.arange(per_host, dtype=jnp.int32),
+                                   sizes, jax.random.key(0))
+    state = init_state(model, tx,
+                       masked_feature_gather(jnp.asarray(feat), n_id),
+                       layers_to_adjs(layers, per_host, sizes),
+                       jax.random.key(1))
+    step = build_dist_train_step(model, tx, sizes, per_host, mesh,
+                                 rows_per_host=dist._rows_per_host)
+
+    g = hosts * per_host
+    sharding = NamedSharding(mesh, P("host"))
+    g2h_j = info.global2host.astype(jnp.int32)
+    for epoch in range(3):
+        perm = rng.permutation(train_idx)
+        t0, losses = time.time(), []
+        for lo in range(0, len(perm) - g + 1, g):
+            seeds = jax.device_put(
+                jnp.asarray(perm[lo:lo + g].astype(np.int32)), sharding)
+            y = jax.device_put(jnp.asarray(labels[perm[lo:lo + g]]),
+                               sharding)
+            state, loss = step(state, dist._spmd_feat, g2h_j,
+                               info.global2local, indptr_j, indices_j,
+                               seeds, y,
+                               jax.random.key(epoch * 1000 + lo))
+            losses.append(float(loss))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f}  "
+              f"{time.time() - t0:.1f}s  ({len(losses)} dist steps)")
+
+    # ---- sanity: the fused exchange really served correct rows -------------
+    ids = jnp.asarray(rng.integers(0, n, g).astype(np.int32))
+    np.testing.assert_allclose(np.asarray(dist[ids]), feat[np.asarray(ids)],
+                               rtol=1e-6)
+    print("feature exchange verified against ground truth")
+
+
+if __name__ == "__main__":
+    main()
