@@ -31,8 +31,8 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm import dist_lookup_local
-from .train import (TrainState, _fused_loss, _pmean_update,
-                    cross_entropy_logits)
+from .train import (TrainState, _check_rows, _fused_loss,
+                    _pmean_update, cross_entropy_logits)
 
 
 def build_dist_train_step(model, tx, sizes: Sequence[int],
@@ -97,16 +97,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
 
     def step(state, feat, g2h, g2l, indptr, indices, seeds, labels, key,
              indices_rows=None, rep_args=()):
-        extra = ()
-        if windowed:
-            if indices_rows is None:
-                raise TypeError(
-                    f"{method} dist step requires indices_rows (the "
-                    "shuffled view; refresh per epoch via permute_csr)")
-            extra += (indices_rows,)
-        elif indices_rows is not None:
-            raise TypeError(
-                f"method={method!r} dist step takes no indices_rows")
+        extra = (indices_rows,) if _check_rows(method, indices_rows,
+                                               "dist") else ()
         if with_replicate:
             if len(rep_args) != 3:
                 raise TypeError(

@@ -29,7 +29,8 @@ import jax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .train import TrainState, _fused_loss, cross_entropy_logits
+from .train import (TrainState, _check_rows, _fused_loss,
+                    cross_entropy_logits)
 
 
 def _leaf_spec(leaf, model_axis: str) -> P:
@@ -64,22 +65,28 @@ def build_gspmd_train_step(model, tx, sizes: Sequence[int], mesh: Mesh,
                            data_axis: str = "data",
                            model_axis: str = "model",
                            loss_fn: Callable = cross_entropy_logits,
-                           method: str = "exact"):
-    """fn(state, feat, forder, indptr, indices, seeds, labels, key) ->
-    (state, loss), with ``state`` placed by ``shard_state`` and
-    seeds/labels of global batch length (any multiple of the ``data``
-    axis size) sharded over ``data_axis``; topology/features
-    replicated. One jitted program; XLA partitions the sampler over the
-    batch shards and the matmuls over the model shards."""
+                           method: str = "exact",
+                           indices_stride: int | None = None):
+    """fn(state, feat, forder, indptr, indices, seeds, labels, key[,
+    indices_rows]) -> (state, loss), with ``state`` placed by
+    ``shard_state`` and seeds/labels of global batch length (any
+    multiple of the ``data`` axis size) sharded over ``data_axis``;
+    topology/features (and, for ``method="rotation"|"window"``, the
+    per-epoch ``indices_rows`` view) replicated. One jitted program;
+    XLA partitions the sampler over the batch shards and the matmuls
+    over the model shards."""
     sizes = list(sizes)
+    windowed = method in ("rotation", "window")
     cache = {}
 
     def step(state: TrainState, feat, forder, indptr, indices, seeds,
-             labels, key):
+             labels, key, *rows):
         loss, grads = jax.value_and_grad(
             lambda p: _fused_loss(model, loss_fn, sizes, seeds.shape[0],
                                   p, feat, forder, indptr, indices, seeds,
-                                  labels, key, method)
+                                  labels, key, method,
+                                  rows[0] if rows else None,
+                                  indices_stride)
         )(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -89,16 +96,21 @@ def build_gspmd_train_step(model, tx, sizes: Sequence[int], mesh: Mesh,
     data = NamedSharding(mesh, P(data_axis))
 
     def sharded_step(state, feat, forder, indptr, indices, seeds, labels,
-                     key):
+                     key, indices_rows=None):
+        _check_rows(method, indices_rows, "gspmd")
         fn = cache.get("fn")
         if fn is None:
             st_sh = state_sharding(state, mesh, model_axis)
+            shardings = [st_sh, repl, repl, repl, repl, data, data, repl]
+            if windowed:
+                shardings.append(repl)
             fn = jax.jit(
                 step,
-                in_shardings=(st_sh, repl, repl, repl, repl, data, data,
-                              repl),
+                in_shardings=tuple(shardings),
                 out_shardings=(st_sh, repl))
             cache["fn"] = fn
-        return fn(state, feat, forder, indptr, indices, seeds, labels, key)
+        extra = (indices_rows,) if windowed else ()
+        return fn(state, feat, forder, indptr, indices, seeds, labels,
+                  key, *extra)
 
     return sharded_step

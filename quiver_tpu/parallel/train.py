@@ -82,6 +82,23 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
     return loss_fn(logits[:batch_size], labels)
 
 
+def _check_rows(method: str, indices_rows, kind: str) -> bool:
+    """Shared indices_rows contract for the step builders: rotation and
+    window REQUIRE the per-epoch shuffled view (as_index_rows /
+    as_index_rows_overlapping; refresh via permute_csr), exact forbids
+    it. Returns whether the method is windowed."""
+    windowed = method in ("rotation", "window")
+    if windowed and indices_rows is None:
+        raise TypeError(
+            f"{method} {kind} step requires indices_rows (the shuffled "
+            "as_index_rows/as_index_rows_overlapping view; refresh per "
+            "epoch via permute_csr)")
+    if not windowed and indices_rows is not None:
+        raise TypeError(f"method={method!r} {kind} step takes no "
+                        "indices_rows")
+    return windowed
+
+
 def _pmean_update(state, tx, grads, loss, axis):
     """Cross-shard gradient/loss reduction + optimizer update (shared by
     the shard_map builders)."""
@@ -161,17 +178,9 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     # opaque shard_map/jit arity failure
     def step(state, feat, forder, indptr, indices, seeds, labels, key,
              indices_rows=None):
-        if method in ("rotation", "window"):
-            if indices_rows is None:
-                raise TypeError(
-                    f"{method} e2e step requires indices_rows (the "
-                    "shuffled as_index_rows view; refresh per epoch via "
-                    "permute_csr)")
+        if _check_rows(method, indices_rows, "e2e"):
             return jitted(state, feat, forder, indptr, indices, seeds,
                           labels, key, indices_rows)
-        if indices_rows is not None:
-            raise TypeError(
-                f"method={method!r} e2e step takes no indices_rows")
         return jitted(state, feat, forder, indptr, indices, seeds, labels,
                       key)
 

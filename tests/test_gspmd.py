@@ -83,6 +83,29 @@ class TestGspmdTrainStep:
             st.params["params"]["conv1"]["lin_root"]["kernel"])
         np.testing.assert_allclose(tp_k, ref_k, rtol=1e-4, atol=1e-6)
 
+    def test_rotation_mode_matches_single_chip(self, setup):
+        model, tx, sizes, bs, indptr, indices, feat, labels, state = setup
+        from quiver_tpu.ops import (as_index_rows, edge_row_ids,
+                                    permute_csr)
+        mesh = make_mesh_2d()
+        rids = edge_row_ids(indptr, int(indices.shape[0]))
+        rows = as_index_rows(permute_csr(indices, rids, jax.random.key(2)))
+        seeds = jnp.arange(bs, dtype=jnp.int32) * 5 % 300
+        y = labels[seeds]
+        key = jax.random.key(13)
+        ref_step = build_train_step(model, tx, sizes, bs,
+                                    method="rotation")
+        _, ref_loss = ref_step(state, feat, None, indptr, indices, seeds,
+                               y, key, rows)
+        tp_step = build_gspmd_train_step(model, tx, sizes, mesh,
+                                         method="rotation")
+        st = shard_state(state, mesh)
+        _, loss = tp_step(st, feat, None, indptr, indices, seeds, y, key,
+                          indices_rows=rows)
+        assert np.allclose(float(loss), float(ref_loss), rtol=1e-5)
+        with pytest.raises(TypeError, match="requires indices_rows"):
+            tp_step(st, feat, None, indptr, indices, seeds, y, key)
+
     def test_loss_decreases_over_steps(self, setup):
         model, tx, sizes, bs, indptr, indices, feat, labels, state = setup
         mesh = make_mesh_2d()
