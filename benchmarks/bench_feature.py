@@ -100,17 +100,21 @@ def main():
         )(jax.random.fold_in(key, 1))
 
         if args.pallas:
-            run = lambda ids: gather_rows(feat, ids)
+            run = gather_rows
         else:
-            run = jax.jit(lambda ids: jnp.take(feat, ids, axis=0))
+            # feat MUST be a jit argument: a closed-over device array is
+            # embedded in the HLO as a literal constant, and shipping a
+            # ~1GB constant through the remote-compile tunnel hangs for
+            # the step's whole timeout
+            run = jax.jit(lambda feat, ids: jnp.take(feat, ids, axis=0))
 
-        out = run(make_ids(jax.random.fold_in(key, 2)))
+        out = run(feat, make_ids(jax.random.fold_in(key, 2)))
         jax.block_until_ready(out)
         label = "pallas" if args.pallas else "xla-take"
 
         t0 = time.perf_counter()
         for i in range(args.iters):
-            out = run(make_ids(jax.random.fold_in(key, 10 + i)))
+            out = run(feat, make_ids(jax.random.fold_in(key, 10 + i)))
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
 

@@ -1,0 +1,51 @@
+#!/bin/sh
+# Continuation of chip_suite.sh from section 4 (the first run hung on
+# bench_feature's closed-over-array remote-compile bug, since fixed).
+# Appends to the same benchmarks/chip_suite.log.
+cd "$(dirname "$0")/.."
+LOG=benchmarks/chip_suite.log
+T=1800
+
+step() {
+    echo "=== $* ===" | tee -a "$LOG"
+    rcfile=$(mktemp)
+    { timeout $T "$@" 2>&1; echo $? > "$rcfile"; } \
+        | grep -v "WARNING" | tee -a "$LOG"
+    rc=$(cat "$rcfile"); rm -f "$rcfile"
+    if [ "$rc" != "0" ]; then
+        echo "=== FAILED rc=$rc (124=timeout): $* ===" | tee -a "$LOG"
+    fi
+}
+
+date | tee -a "$LOG"
+
+# 4. feature gather GB/s: raw device, pallas kernel, tiered grid
+step python -u benchmarks/bench_feature.py
+step python -u benchmarks/bench_feature.py --bf16
+step python -u benchmarks/bench_feature.py --pallas
+step python -u benchmarks/bench_feature.py --tiered 1.0
+step python -u benchmarks/bench_feature.py --tiered 0.2 --batch 100000
+step python -u benchmarks/bench_feature.py --tiered 0.2 --batch 100000 --prefetch
+step python -u benchmarks/bench_feature.py --tiered 0.0 --batch 100000
+step python -u benchmarks/bench_feature.py --tiered 0.0 --batch 100000 --prefetch
+
+# 5. pallas sampling kernel vs jnp hop-1 (apples-to-apples)
+step python -u benchmarks/bench_sampler.py --pallas
+step python -u benchmarks/bench_sampler.py --hop1 exact
+step python -u benchmarks/bench_sampler.py --hop1 rotation
+
+# 2b. window mode re-measure after the Fisher-Yates rewrite
+step env QT_BENCH_LAYOUT=overlap python -u bench.py
+
+# 6. end-to-end epoch seconds vs the reference's 11.1 s
+step python -u benchmarks/bench_e2e.py --method rotation --layout overlap
+step python -u benchmarks/bench_e2e.py --method rotation --layout pair
+step python -u benchmarks/bench_e2e.py --method window --layout overlap
+step python -u benchmarks/bench_e2e.py --method exact
+step python -u benchmarks/bench_e2e.py --method rotation --layout overlap --bf16
+# 7. primitive/gather micro tables for the docs
+step python -u benchmarks/micro_ops.py --suite gather --iters 10
+step python -u benchmarks/micro_ops.py --suite primitives --iters 10
+
+date | tee -a "$LOG"
+echo "chip suite (continuation) complete -> $LOG"
