@@ -144,12 +144,17 @@ def main():
                                    ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import jax.numpy as jnp
-    from quiver_tpu.ops import (sample_multihop, permute_csr, edge_row_ids,
+    from quiver_tpu.ops import (sample_multihop, reshuffle_csr, edge_row_ids,
                                 as_index_rows, as_index_rows_overlapping)
     # rotation row layout: "overlap" = one gather/seed, 2x index memory;
     # "pair" = two gathers/seed; "both" (default) measures the two and
     # reports the better as the metric of record, layout labeled
     layout_env = os.environ.get("QT_BENCH_LAYOUT", "both")
+    # per-epoch row-order refresh: "sort" = exact uniform shuffle
+    # (permute_csr), "butterfly" = the ~40x cheaper masked swap network
+    # (accuracy parity for both: benchmarks/accuracy_parity.py,
+    # docs/introduction.md)
+    shuffle = os.environ.get("QT_BENCH_SHUFFLE", "sort")
 
     key = jax.random.key(0)
 
@@ -184,13 +189,14 @@ def main():
     # measures a full epoch the way training runs it: one per-epoch row
     # re-shuffle (rotation sampling's freshness source) + `batches`
     # sample_multihop calls.
-    def make_epoch(n_batches, method, layout):
+    def make_epoch(n_batches, method, layout, shuffle=shuffle):
         @jax.jit
         def run_epoch(indptr, indices, row_ids, key):
             kperm, kseed, kbatch = jax.random.split(key, 3)
             stride = None
             if method in ("rotation", "window"):
-                permuted = permute_csr(indices, row_ids, kperm)
+                permuted = reshuffle_csr(indices, row_ids, kperm,
+                                         method=shuffle)
                 if layout == "overlap":
                     rows = as_index_rows_overlapping(permuted)
                     stride = 128
@@ -220,8 +226,8 @@ def main():
             return total
         return run_epoch
 
-    def measure(n_batches, method, layout, salt):
-        run = make_epoch(n_batches, method, layout)
+    def measure(n_batches, method, layout, salt, shuffle=shuffle):
+        run = make_epoch(n_batches, method, layout, shuffle)
         jax.block_until_ready(run(indptr, indices, row_ids,
                                   jax.random.fold_in(key, 100 + salt)))
         t0 = time.perf_counter()
@@ -255,11 +261,19 @@ def main():
         "vs_baseline": round(seps / BASELINE_SEPS, 3),
         "mode": "rotation",
         "layout": layout,
+        "shuffle": shuffle,
         "exact_mode_value": round(exact_seps, 1),
         "exact_mode_vs_baseline": round(exact_seps / BASELINE_SEPS, 3),
         "window_mode_value": round(window_seps, 1),
         "window_mode_vs_baseline": round(window_seps / BASELINE_SEPS, 3),
     }
+    if shuffle == "sort":
+        # secondary figure: the cheap butterfly epoch-reshuffle on the
+        # full epoch (promotion candidate; parity evidence in docs)
+        bf = measure(batches, "rotation", layout, 12, shuffle="butterfly")
+        out["butterfly_value"] = round(bf, 1)
+        out["butterfly_vs_baseline"] = (
+            round(bf / BASELINE_SEPS, 3) if not cpu_smoke else None)
     if cpu_smoke:
         # not comparable to the TPU baseline — null the ratio so a parser
         # that ignores the platform key can't record a bogus comparison

@@ -73,7 +73,11 @@ def main():
                          "differences can show")
     ap.add_argument("--methods", nargs="+",
                     default=["exact", "rotation"],
-                    choices=["exact", "rotation", "window"])
+                    choices=["exact", "rotation", "window",
+                             "rotation-bfly"],
+                    help="rotation-bfly = rotation sampling with the "
+                         "cheap composed butterfly epoch-reshuffle "
+                         "instead of the exact sort shuffle")
     args = ap.parse_args()
 
     from _common import configure_jax
@@ -81,8 +85,8 @@ def main():
     import jax.numpy as jnp
     import optax
     from quiver_tpu.models import GraphSAGE
-    from quiver_tpu.ops import (as_index_rows, edge_row_ids, permute_csr,
-                                sample_multihop)
+    from quiver_tpu.ops import (as_index_rows, butterfly_shuffle,
+                                edge_row_ids, permute_csr, sample_multihop)
     from quiver_tpu.parallel.train import (build_train_step, init_state,
                                            layers_to_adjs,
                                            masked_feature_gather)
@@ -129,7 +133,9 @@ def main():
         return hits / (len(test_idx) // bs * bs)
 
     def train_one(method, seed):
-        step = build_train_step(model, tx, sizes, bs, method=method)
+        bfly = method == "rotation-bfly"
+        step = build_train_step(model, tx, sizes, bs,
+                                method="rotation" if bfly else method)
         srng = np.random.default_rng(seed)
         key = jax.random.key(seed)
         seeds0 = jnp.asarray(train_idx[:bs].astype(np.int32))
@@ -139,9 +145,14 @@ def main():
                            layers_to_adjs(layers, bs, sizes),
                            jax.random.fold_in(key, 1))
         it = 0
+        cur = indices_j        # composed butterfly state
         for epoch in range(args.epochs):
             rows = None
-            if method in ("rotation", "window"):
+            if bfly:
+                cur = butterfly_shuffle(
+                    cur, row_ids, jax.random.fold_in(key, 5000 + epoch))
+                rows = as_index_rows(cur)
+            elif method in ("rotation", "window"):
                 rows = as_index_rows(permute_csr(
                     indices_j, row_ids, jax.random.fold_in(key, 5000 + epoch)))
             eperm = srng.permutation(train_idx)

@@ -100,6 +100,12 @@ def main():
         )(jax.random.fold_in(key, 1))
 
         if args.pallas:
+            if args.dim % 128:
+                # pre-pad outside the timed loop: gather_rows would
+                # otherwise re-pad the whole table every call and the
+                # GB/s figure would measure the pad copy, not the kernel
+                feat = jnp.pad(feat, ((0, 0), (0, 128 - args.dim % 128)))
+                jax.block_until_ready(feat)
             run = gather_rows
         else:
             # feat MUST be a jit argument: a closed-over device array is

@@ -3,6 +3,8 @@ from .sample import (
     sample_layer_rotation,
     sample_layer_window,
     permute_csr,
+    butterfly_shuffle,
+    reshuffle_csr,
     as_index_rows,
     as_index_rows_overlapping,
     edge_row_ids,
@@ -23,6 +25,8 @@ __all__ = [
     "sample_layer_rotation",
     "sample_layer_window",
     "permute_csr",
+    "butterfly_shuffle",
+    "reshuffle_csr",
     "as_index_rows",
     "as_index_rows_overlapping",
     "edge_row_ids",

@@ -59,8 +59,16 @@ def _gather_kernel(ids_ref, feat_ref, out_ref, scratch, sems):
 def gather_rows(feat: jax.Array, ids: jax.Array,
                 interpret: bool = False) -> jax.Array:
     """out[i] = feat[ids[i]] with ids in [0, N). ids length must be a
-    multiple of the block size (pad with any valid id and slice after)."""
+    multiple of the block size (pad with any valid id and slice after).
+
+    Mosaic requires the per-row HBM DMA slice to be lane-aligned: the
+    feature dim must be a multiple of 128. Other dims are zero-padded
+    here — a full-table copy per call, so hot paths should store their
+    table 128-padded (``Feature`` does) and hit the fast branch."""
     b = ids.shape[0]
+    out_dim = feat.shape[1]
+    if out_dim % 128:
+        feat = jnp.pad(feat, ((0, 0), (0, 128 - out_dim % 128)))
     dim = feat.shape[1]
     if b % _BLOCK_ROWS:
         pad = _BLOCK_ROWS - b % _BLOCK_ROWS
@@ -86,7 +94,7 @@ def gather_rows(feat: jax.Array, ids: jax.Array,
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
     )(ids.astype(jnp.int32), feat)
-    return out[:b]
+    return out[:b, :out_dim]
 
 
 def gather_rows_reference(feat: jax.Array, ids: jax.Array) -> jax.Array:

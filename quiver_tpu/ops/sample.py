@@ -162,6 +162,100 @@ def permute_csr(indices: jax.Array, row_ids: jax.Array,
     return permuted
 
 
+def butterfly_shuffle(indices: jax.Array, row_ids: jax.Array,
+                      key: jax.Array, with_slot_map: bool = False,
+                      max_stride: int = 128):
+    """Cheap per-epoch within-row re-mix: a masked butterfly network.
+
+    ``permute_csr`` (exact uniform per-row shuffle) costs a 2-key sort
+    over the whole edge array — ~650 ms/epoch on a products-scale graph,
+    ~23% of a sampling epoch. Rotation/window sampling only need the row
+    order to be *fresh* each epoch (the draw's own random offset supplies
+    marginal randomness); this provides freshness at ~2% of the sort's
+    cost with zero gathers:
+
+    for stride s in 1,2,4,...,``max_stride``: view the (phase-rolled)
+    edge array as [E/2s, 2, s] and swap the two halves of each block
+    elementwise where (a) both positions belong to the same CSR row and
+    (b) a fresh coin says so. Pairing is position XOR s, expressed as a
+    reshape — no gather/scatter. A per-epoch random phase roll re-aligns
+    the pairing blocks so hub rows (deg > 2*``max_stride``) also mix
+    across block boundaries over epochs. Elements provably never leave
+    their row (a swap requires both sides in the row), so the CSR
+    structure is preserved exactly.
+
+    One pass is not a uniform shuffle; composed over epochs (fresh coins
+    + fresh phase each call — pass the PREVIOUS epoch's output back in)
+    the order keeps mixing. Accuracy parity with exact sampling is
+    recorded in docs/introduction.md alongside the sort-based shuffle.
+
+    Returns the re-ordered edge array; with ``with_slot_map`` also the
+    slot map — but note the map is INPUT-relative (``out[p] ==
+    indices[slot_map[p]]`` for the array passed in), unlike
+    ``permute_csr`` whose input is always the original CSR order. Under
+    the feed-output-back-in composition, edge-id tracking must compose
+    maps across epochs: ``running = running[slot_map_this_epoch]``.
+    """
+    e = indices.shape[0]
+    out = indices.astype(jnp.int32)
+    payload = (jnp.arange(e, dtype=jnp.int32) if with_slot_map else None)
+    kphi, kcoin = jax.random.split(key)
+    # phase-roll so pairing-block alignment differs per epoch
+    phi = jax.random.randint(kphi, (), 0, e, dtype=jnp.int32)
+    out = jnp.roll(out, phi)
+    rows = jnp.roll(row_ids, phi)
+    if payload is not None:
+        payload = jnp.roll(payload, phi)
+
+    s = 1
+    pass_i = 0
+    while s <= max_stride:
+        pad = (-e) % (2 * s)
+        def blocks(x, fill):
+            if pad:
+                x = jnp.concatenate(
+                    [x, jnp.full((pad,), fill, x.dtype)])
+            return x.reshape(-1, 2, s)
+        rb = blocks(rows, -2)
+        same = rb[:, 0, :] == rb[:, 1, :]
+        coin = jax.random.bernoulli(
+            jax.random.fold_in(kcoin, pass_i), 0.5, same.shape)
+        do = same & coin
+
+        def swap(x, fill):
+            xb = blocks(x, fill)
+            lo = jnp.where(do, xb[:, 1, :], xb[:, 0, :])
+            hi = jnp.where(do, xb[:, 0, :], xb[:, 1, :])
+            return jnp.stack([lo, hi], axis=1).reshape(-1)[:e]
+
+        out = swap(out, -1)
+        if payload is not None:
+            payload = swap(payload, -1)
+        s *= 2
+        pass_i += 1
+
+    out = jnp.roll(out, -phi)
+    if payload is not None:
+        return out, jnp.roll(payload, -phi)
+    return out
+
+
+def reshuffle_csr(indices: jax.Array, row_ids: jax.Array, key: jax.Array,
+                  method: str = "sort", with_slot_map: bool = False):
+    """Per-epoch row-order refresh for rotation/window sampling:
+    ``method="sort"`` = ``permute_csr`` (exact uniform per-row shuffle,
+    O(E log E) sort), ``"butterfly"`` = ``butterfly_shuffle`` (~40x
+    cheaper masked swap network; composes toward uniform over epochs —
+    feed each epoch's output into the next call)."""
+    if method == "sort":
+        return permute_csr(indices, row_ids, key,
+                           with_slot_map=with_slot_map)
+    if method == "butterfly":
+        return butterfly_shuffle(indices, row_ids, key,
+                                 with_slot_map=with_slot_map)
+    raise ValueError(f"unknown reshuffle method {method!r}")
+
+
 def as_index_rows(indices: jax.Array, width: int = 128) -> jax.Array:
     """Pad + reshape the CSR ``indices`` array into 128-wide rows. TPU
     random access costs ~25ns per gather *index* regardless of row width

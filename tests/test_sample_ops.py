@@ -373,6 +373,113 @@ class TestRotationSampler:
                 sorted(indices[lo:hi].tolist())
 
 
+class TestButterflyShuffle:
+    """butterfly_shuffle: the cheap per-epoch re-mix must preserve CSR
+    structure exactly and actually mix within rows."""
+
+    def _hub_graph(self):
+        # rows of assorted sizes incl. a 600-neighbor hub (> 2x the
+        # 256 pairing block, exercising the phase-roll path)
+        degs = [0, 1, 3, 17, 64, 600, 5, 129]
+        indptr = np.zeros(len(degs) + 1, np.int64)
+        np.cumsum(degs, out=indptr[1:])
+        indices = np.arange(int(indptr[-1]), dtype=np.int32) * 7 % 1000
+        return indptr, indices
+
+    def test_preserves_rows(self):
+        from quiver_tpu.ops import butterfly_shuffle, edge_row_ids
+        indptr, indices = self._hub_graph()
+        row_ids = edge_row_ids(jnp.asarray(indptr), len(indices))
+        perm = np.asarray(butterfly_shuffle(
+            jnp.asarray(indices), row_ids, KEY))
+        for v in range(len(indptr) - 1):
+            lo, hi = indptr[v], indptr[v + 1]
+            assert sorted(perm[lo:hi].tolist()) == \
+                sorted(indices[lo:hi].tolist())
+
+    def test_slot_map_contract(self):
+        from quiver_tpu.ops import butterfly_shuffle, edge_row_ids
+        indptr, indices = self._hub_graph()
+        row_ids = edge_row_ids(jnp.asarray(indptr), len(indices))
+        perm, smap = butterfly_shuffle(jnp.asarray(indices), row_ids,
+                                       KEY, with_slot_map=True)
+        np.testing.assert_array_equal(
+            np.asarray(perm), indices[np.asarray(smap)])
+
+    def test_mixes_positions_over_epochs(self):
+        # composing epochs (output fed back in) must spread the element
+        # that starts at a row's first slot over the whole row
+        from quiver_tpu.ops import butterfly_shuffle, edge_row_ids
+        deg = 64
+        indptr = np.array([0, deg], np.int64)
+        base = np.arange(deg, dtype=np.int32)
+        row_ids = edge_row_ids(jnp.asarray(indptr), deg)
+        lands = np.zeros(deg, np.int64)
+        trials = 200
+        for t in range(trials):
+            cur = jnp.asarray(base)
+            for ep in range(3):
+                cur = butterfly_shuffle(
+                    cur, row_ids, jax.random.key(1000 * t + ep))
+            lands[int(np.asarray(cur).tolist().index(0))] += 1
+        freq = lands / trials
+        # uniform would be 1/64 ~ 0.0156; require no position starved
+        # or hoarding (loose 4x band — 3 composed epochs, not exact)
+        assert freq.max() < 4 / deg
+        assert (lands > 0).sum() > deg * 0.5
+
+    def test_orders_differ_across_keys(self):
+        from quiver_tpu.ops import butterfly_shuffle, edge_row_ids
+        indptr, indices = self._hub_graph()
+        row_ids = edge_row_ids(jnp.asarray(indptr), len(indices))
+        a = np.asarray(butterfly_shuffle(jnp.asarray(indices), row_ids,
+                                         jax.random.key(1)))
+        b = np.asarray(butterfly_shuffle(jnp.asarray(indices), row_ids,
+                                         jax.random.key(2)))
+        assert not np.array_equal(a, b)
+
+    def test_reshuffle_dispatch(self, small_graph):
+        from quiver_tpu.ops import (butterfly_shuffle, edge_row_ids,
+                                    permute_csr, reshuffle_csr)
+        indptr, indices = small_graph
+        row_ids = edge_row_ids(jnp.asarray(indptr), len(indices))
+        np.testing.assert_array_equal(
+            np.asarray(reshuffle_csr(jnp.asarray(indices), row_ids, KEY,
+                                     method="sort")),
+            np.asarray(permute_csr(jnp.asarray(indices), row_ids, KEY)))
+        np.testing.assert_array_equal(
+            np.asarray(reshuffle_csr(jnp.asarray(indices), row_ids, KEY,
+                                     method="butterfly")),
+            np.asarray(butterfly_shuffle(jnp.asarray(indices), row_ids,
+                                         KEY)))
+        with pytest.raises(ValueError, match="unknown reshuffle"):
+            reshuffle_csr(jnp.asarray(indices), row_ids, KEY,
+                          method="bogus")
+
+    def test_rotation_uniform_with_butterfly_epochs(self):
+        # the rotation draw's neighbor marginal over composed butterfly
+        # epochs should approach uniform (the property permute_csr
+        # provides exactly, test above at :352-363)
+        from quiver_tpu.ops import (as_index_rows, butterfly_shuffle,
+                                    edge_row_ids, sample_layer_rotation)
+        deg, k = 40, 5
+        indptr = np.array([0, deg], np.int64)
+        base = np.arange(deg, dtype=np.int32)
+        row_ids = edge_row_ids(jnp.asarray(indptr), deg)
+        seeds = jnp.zeros((64,), jnp.int32)
+        counts = np.zeros(deg, np.int64)
+        cur = jnp.asarray(base)
+        for ep in range(60):
+            cur = butterfly_shuffle(cur, row_ids, jax.random.key(500 + ep))
+            nbrs, _ = sample_layer_rotation(
+                jnp.asarray(indptr), as_index_rows(cur), seeds, k,
+                jax.random.key(9000 + ep))
+            got = np.asarray(nbrs).ravel()
+            np.add.at(counts, got[got >= 0], 1)
+        freq = counts / counts.sum()
+        np.testing.assert_allclose(freq, 1 / deg, atol=0.012)
+
+
 class TestCompactLayer:
     def test_seeds_first_and_unique(self):
         seeds = jnp.array([7, 3, 9], jnp.int32)
