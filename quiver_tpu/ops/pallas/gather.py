@@ -64,7 +64,7 @@ def gather_rows(feat: jax.Array, ids: jax.Array,
     Mosaic requires the per-row HBM DMA slice to be lane-aligned: the
     feature dim must be a multiple of 128. Other dims are zero-padded
     here — a full-table copy per call, so hot paths should store their
-    table 128-padded (``Feature`` does) and hit the fast branch."""
+    table 128-padded up front and hit the fast branch."""
     b = ids.shape[0]
     out_dim = feat.shape[1]
     if out_dim % 128:

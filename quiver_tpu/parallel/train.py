@@ -117,7 +117,8 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]). With ``method="rotation"`` pass the shuffled
     ``as_index_rows`` view as ``indices_rows`` (refresh per epoch with
-    ``permute_csr``) — or, with ``indices_stride=128``, the
+    ``reshuffle_csr`` — exact sort or cheap butterfly) — or, with
+    ``indices_stride=128``, the
     ``as_index_rows_overlapping`` view (one row gather per seed, 2x
     index memory)."""
     sizes = list(sizes)

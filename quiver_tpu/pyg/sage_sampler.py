@@ -97,7 +97,8 @@ class GraphSageSampler:
     def __init__(self, csr_topo: CSRTopo, sizes: Sequence[int],
                  device=None, mode: str = "HBM", seed: int = 0,
                  edge_weight=None, sampling: str = "exact",
-                 with_eid: bool = False):
+                 with_eid: bool = False, layout: str = "pair",
+                 shuffle: str = "sort"):
         if mode not in ("HBM", "HOST", "CPU", "UVA", "GPU"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         # accept reference mode names: UVA -> HOST tier, GPU -> HBM
@@ -135,11 +136,35 @@ class GraphSageSampler:
             raise ValueError("with_eid is not supported in CPU mode")
         self.with_eid = with_eid
         self.sampling = sampling
+        # layout="overlap": rotation/window do ONE 256-wide row gather
+        # per seed instead of two 128-wide (fastest measured config,
+        # docs/introduction.md) at 2x index memory. shuffle="butterfly":
+        # the ~40x cheaper epoch reshuffle (masked swap network composed
+        # across epochs) instead of the exact per-epoch sort.
+        if layout not in ("pair", "overlap"):
+            raise ValueError(f"unknown rotation layout {layout!r}")
+        if shuffle not in ("sort", "butterfly"):
+            raise ValueError(f"unknown shuffle {shuffle!r}")
+        if shuffle == "butterfly" and sampling == "window":
+            # window anchors its ~256-entry window at the segment start
+            # and relies on the reshuffle to re-place hub neighbors
+            # uniformly; butterfly moves an element <= 255 positions per
+            # epoch, so a hub's far neighbors would stay unreachable for
+            # many epochs — silent sampling bias. Rotation is safe (its
+            # random offset walks the whole segment every draw).
+            raise ValueError(
+                "shuffle='butterfly' cannot provide window sampling's "
+                "mandatory hub re-placement (bounded per-epoch "
+                "displacement); use shuffle='sort' with window mode, or "
+                "sampling='rotation' with butterfly")
+        self.layout = layout
+        self.shuffle = shuffle
         self._key = jax.random.key(seed)
         self._placed = None
         self._weight_placed = None
-        self._rot = None          # shuffled as_index_rows view
+        self._rot = None          # shuffled row view (pair or overlap)
         self._rot_eid = None      # slot->edge-id map in permuted coords
+        self._permuted = None     # flat permuted indices (butterfly state)
         self._row_ids = None
         self._fns = {}
 
@@ -180,8 +205,14 @@ class GraphSageSampler:
     def reshuffle(self, key=None):
         """Re-shuffle every CSR row's neighbor order (rotation sampling's
         freshness source). Called automatically on first sample; call at
-        each epoch boundary thereafter. ~4ms/1M edges."""
-        from ..ops.sample import as_index_rows, edge_row_ids, permute_csr
+        each epoch boundary thereafter. shuffle="sort": exact uniform
+        per-row shuffle (one 2-key sort over the edge array, ~650ms per
+        100M edges). shuffle="butterfly": the ~40x cheaper masked swap
+        network, composed across calls (this method keeps the running
+        permuted state and the composed edge-id map for you)."""
+        from ..ops.sample import (as_index_rows, as_index_rows_overlapping,
+                                  butterfly_shuffle, edge_row_ids,
+                                  permute_csr)
         self.lazy_init_quiver()
         indptr, indices = self._placed
         indptr = jnp.asarray(indptr)
@@ -190,15 +221,42 @@ class GraphSageSampler:
             self._row_ids = jax.jit(edge_row_ids, static_argnums=1)(
                 indptr, int(indices.shape[0]))
         pkey = key if key is not None else self.next_key()
-        if self.with_eid:
+        base = self.csr_topo.eid if self.with_eid else None
+        if self.shuffle == "butterfly":
+            src = self._permuted if self._permuted is not None else indices
+            if self.with_eid:
+                permuted, smap = butterfly_shuffle(
+                    src, self._row_ids, pkey, with_slot_map=True)
+                # smap is input-relative: compose with the running map
+                if self._rot_eid is not None:
+                    self._rot_eid = self._rot_eid[smap]
+                elif base is not None:
+                    self._rot_eid = jnp.asarray(base)[smap]
+                else:
+                    self._rot_eid = smap
+            else:
+                permuted = butterfly_shuffle(src, self._row_ids, pkey)
+            if self.mode == "HOST":
+                # HOST mode exists because the E-sized edge array does
+                # not fit HBM; the persistent butterfly state gets the
+                # same host placement as the rows view below
+                try:
+                    sh = jax.sharding.SingleDeviceSharding(
+                        list(permuted.devices())[0],
+                        memory_kind="pinned_host")
+                    permuted = jax.device_put(permuted, sh)
+                except (ValueError, NotImplementedError):
+                    pass
+            self._permuted = permuted
+        elif self.with_eid:
             permuted, smap = permute_csr(indices, self._row_ids, pkey,
                                          with_slot_map=True)
-            base = self.csr_topo.eid
             self._rot_eid = (smap if base is None
                              else jnp.asarray(base)[smap])
         else:
             permuted = permute_csr(indices, self._row_ids, pkey)
-        rows = as_index_rows(permuted)
+        rows = (as_index_rows_overlapping(permuted)
+                if self.layout == "overlap" else as_index_rows(permuted))
         if self.mode == "HOST":
             # keep the shuffled topology host-resident (the mode exists
             # because indices don't fit HBM); the sampler's row fetches
@@ -227,6 +285,8 @@ class GraphSageSampler:
                                   or self.csr_topo.eid is not None)
                         else "slots")
 
+        stride = 128 if self.layout == "overlap" else None
+
         def run(indptr, indices, seeds, key, weights=None, rows=None,
                 eid_arr=None):
             from ..ops.sample_multihop import sample_multihop
@@ -234,7 +294,9 @@ class GraphSageSampler:
             return sample_multihop(indptr, indices, seeds, sizes, key,
                                    edge_weight=weights if weighted else None,
                                    method=method, indices_rows=rows,
-                                   eid=eid)
+                                   eid=eid,
+                                   indices_stride=stride if rows is not None
+                                   else None)
 
         return jax.jit(run)
 

@@ -102,6 +102,30 @@ class TestGraphSageSamplerHBM:
         n_id2, _, adjs2 = sampler.sample(seeds)
         check_sample_output(topo, seeds, n_id2, bs, adjs2, [5, 3])
 
+    def test_overlap_layout_butterfly_shuffle(self, topo, rng):
+        # the fastest measured config: one 256-wide gather per seed +
+        # the cheap composed epoch reshuffle
+        sampler = qv.GraphSageSampler(topo, sizes=[5, 3], mode="HBM",
+                                      sampling="rotation",
+                                      layout="overlap",
+                                      shuffle="butterfly")
+        seeds = rng.choice(topo.node_count, 32, replace=False)
+        for _ in range(3):           # three composed epochs
+            n_id, bs, adjs = sampler.sample(seeds)
+            check_sample_output(topo, seeds, n_id, bs, adjs, [5, 3])
+            sampler.reshuffle()
+
+    def test_bad_layout_and_shuffle_rejected(self, topo):
+        with pytest.raises(ValueError, match="layout"):
+            qv.GraphSageSampler(topo, [5], layout="wide")
+        with pytest.raises(ValueError, match="shuffle"):
+            qv.GraphSageSampler(topo, [5], shuffle="fisher")
+        # butterfly's bounded per-epoch displacement can't give window
+        # mode the hub re-placement its statistics require
+        with pytest.raises(ValueError, match="butterfly"):
+            qv.GraphSageSampler(topo, [5], sampling="window",
+                                shuffle="butterfly")
+
 
 def _coo_graph(rng, n=120, e=900):
     coo = rng.integers(0, n, (2, e))
@@ -159,6 +183,20 @@ class TestEdgeIdTracking:
         sampler.reshuffle()
         n_id, bs, adjs = sampler.sample(seeds)
         check_eids(coo, n_id, adjs)
+
+    def test_butterfly_eids_compose_across_reshuffles(self, rng):
+        # butterfly's slot map is input-relative; the sampler must
+        # compose the running map so e_ids stay original-COO-correct
+        # after several epochs
+        coo, topo = _coo_graph(rng)
+        sampler = qv.GraphSageSampler(topo, sizes=[4, 3],
+                                      sampling="rotation",
+                                      shuffle="butterfly", with_eid=True)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        for _ in range(3):
+            n_id, bs, adjs = sampler.sample(seeds)
+            check_eids(coo, n_id, adjs)
+            sampler.reshuffle()
 
     def test_weighted_mode_eids(self, rng):
         from quiver_tpu.ops.weighted import csr_weights_from_eid
