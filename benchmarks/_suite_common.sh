@@ -1,0 +1,23 @@
+# Shared helper for the on-chip suite scripts. Source from a script
+# that has set LOG (the append-target) — and optionally T (per-step
+# timeout seconds, default 1800).
+#
+# NEVER kill a step mid-claim — a killed TPU process can wedge the
+# device claim for ~30+ minutes; the per-step timeout is the only
+# reaper.
+T=${T:-1800}
+
+# pipeline status would be tee's, not the command's (POSIX sh has no
+# PIPESTATUS) — capture the real rc via a temp file so a crash or a
+# timeout is loudly marked in the log instead of reading as a silently
+# truncated success
+step() {
+    echo "=== $* ===" | tee -a "$LOG"
+    rcfile=$(mktemp)
+    { timeout "$T" "$@" 2>&1; echo $? > "$rcfile"; } \
+        | grep -v "WARNING" | tee -a "$LOG"
+    rc=$(cat "$rcfile"); rm -f "$rcfile"
+    if [ "$rc" != "0" ]; then
+        echo "=== FAILED rc=$rc (124=timeout): $* ===" | tee -a "$LOG"
+    fi
+}

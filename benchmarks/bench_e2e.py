@@ -31,16 +31,23 @@ def main():
                    choices=["rotation", "window", "exact"])
     p.add_argument("--layout", default="pair", choices=["pair", "overlap"],
                    help="rotation row layout (overlap = one gather/seed)")
+    p.add_argument("--shuffle", default="sort",
+                   choices=["sort", "butterfly"],
+                   help="per-epoch row reshuffle: exact sort or the "
+                        "~40x cheaper butterfly network")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 feature storage")
     args = p.parse_args()
+    if args.method == "window" and args.shuffle == "butterfly":
+        sys.exit("window+butterfly is statistically unsound for hubs "
+                 "(see GraphSageSampler's rejection of the combo)")
 
     from _common import configure_jax
     jax = configure_jax()
     import jax.numpy as jnp
     import optax
     from quiver_tpu.models import GraphSAGE
-    from quiver_tpu.ops import (sample_multihop, permute_csr, edge_row_ids,
+    from quiver_tpu.ops import (sample_multihop, reshuffle_csr, edge_row_ids,
                                 as_index_rows, as_index_rows_overlapping)
     from quiver_tpu.parallel.train import (
         TrainState, _fused_loss, cross_entropy_logits, layers_to_adjs,
@@ -92,8 +99,9 @@ def main():
     @jax.jit
     def epoch(state, indptr, indices, row_ids, feat, labels_all, key):
         if windowed:
-            permuted = permute_csr(indices, row_ids,
-                                   jax.random.fold_in(key, 0))
+            permuted = reshuffle_csr(indices, row_ids,
+                                     jax.random.fold_in(key, 0),
+                                     method=args.shuffle)
             rows = (as_index_rows_overlapping(permuted) if stride
                     else as_index_rows(permuted))
         else:
@@ -135,6 +143,7 @@ def main():
     dt = time.perf_counter() - t0
     print(f"[{method}"
           f"{'/' + args.layout if windowed else ''}"
+          f"{'/bfly' if windowed and args.shuffle == 'butterfly' else ''}"
           f"{' bf16' if args.bf16 else ''}] epoch "
           f"{dt:.2f}s ({args.batches} batches x {bs}; "
           f"first+compile {compile_and_first:.1f}s)  "

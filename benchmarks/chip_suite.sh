@@ -9,22 +9,7 @@
 cd "$(dirname "$0")/.."
 LOG=benchmarks/chip_suite.log
 QUICK="$1"
-T=1800
-
-# pipeline status would be tee's, not the command's (POSIX sh has no
-# PIPESTATUS) — capture the real rc via a temp file so a crash or a
-# 1800s timeout is loudly marked in the log instead of reading as a
-# silently truncated success
-step() {
-    echo "=== $* ===" | tee -a "$LOG"
-    rcfile=$(mktemp)
-    { timeout $T "$@" 2>&1; echo $? > "$rcfile"; } \
-        | grep -v "WARNING" | tee -a "$LOG"
-    rc=$(cat "$rcfile"); rm -f "$rcfile"
-    if [ "$rc" != "0" ]; then
-        echo "=== FAILED rc=$rc (124=timeout): $* ===" | tee -a "$LOG"
-    fi
-}
+. benchmarks/_suite_common.sh
 
 : > "$LOG"
 date | tee -a "$LOG"

@@ -1,51 +1,43 @@
 #!/bin/sh
-# Continuation 2: remaining on-chip steps. Tiered host-tier configs run
-# at REDUCED scale — host<->device bytes traverse the remote-chip tunnel
-# in this environment, so those numbers measure the tunnel, not the
-# design (recorded with that caveat); full scale would eat the 1800s
-# timeout per step.
+# Rerun of the remaining on-chip sweep after the backend outage, highest
+# value first. Appends to benchmarks/chip_suite.log. NEVER kill a step
+# mid-claim — a killed TPU process wedges the device for ~30+ minutes
+# (it cost us an hour today); the per-step timeout is the only reaper.
 cd "$(dirname "$0")/.."
 LOG=benchmarks/chip_suite.log
-T=1800
-
-step() {
-    echo "=== $* ===" | tee -a "$LOG"
-    rcfile=$(mktemp)
-    { timeout $T "$@" 2>&1; echo $? > "$rcfile"; } \
-        | grep -v "WARNING" | tee -a "$LOG"
-    rc=$(cat "$rcfile"); rm -f "$rcfile"
-    if [ "$rc" != "0" ]; then
-        echo "=== FAILED rc=$rc (124=timeout): $* ===" | tee -a "$LOG"
-    fi
-}
+. benchmarks/_suite_common.sh
 
 date | tee -a "$LOG"
 
-# 4a. why is the tiered-100% lookup slow? per-call dispatch probe
+# 1. metric of record + FY window + butterfly secondary (new code)
+step env QT_BENCH_LAYOUT=overlap python -u bench.py
+# butterfly as primary (labeled), for the full-epoch record
+step env QT_BENCH_LAYOUT=overlap QT_BENCH_SHUFFLE=butterfly python -u bench.py
+
+# 2. dispatch probe (tiered-100% mystery; now exercises the fused
+#    single-dispatch Feature path)
 step python -u benchmarks/debug_dispatch.py
 
-# 4b. pallas gather (after the 128-align fix): native dim-128 and the
-#     padded dim-100 fallback, vs xla take at dim 128
+# 3. pallas sampling kernel vs jnp hop-1 (apples-to-apples)
+step python -u benchmarks/bench_sampler.py --pallas
+step python -u benchmarks/bench_sampler.py --hop1 exact
+step python -u benchmarks/bench_sampler.py --hop1 rotation
+
+# 4. pallas gather (128-aligned + padded fallback) vs xla take
 step python -u benchmarks/bench_feature.py --pallas --dim 128
 step python -u benchmarks/bench_feature.py --dim 128
 step python -u benchmarks/bench_feature.py --pallas
 
-# 4c. tiered host-tier grid at tunnel-sized scale
+# 5. tiered host-tier grid at tunnel-sized scale (tunnel-bound numbers,
+#    recorded with that caveat)
 step python -u benchmarks/bench_feature.py --tiered 0.2 --rows 300000 --batch 20000 --iters 5
 step python -u benchmarks/bench_feature.py --tiered 0.2 --rows 300000 --batch 20000 --iters 5 --prefetch
 step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5
 step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5 --prefetch
 
-# 5. pallas sampling kernel vs jnp hop-1 (apples-to-apples)
-step python -u benchmarks/bench_sampler.py --pallas
-step python -u benchmarks/bench_sampler.py --hop1 exact
-step python -u benchmarks/bench_sampler.py --hop1 rotation
-
-# 2b. bench after the window Fisher-Yates rewrite + butterfly secondary
-step env QT_BENCH_LAYOUT=overlap python -u bench.py
-
 # 6. end-to-end epoch seconds vs the reference's 11.1 s
 step python -u benchmarks/bench_e2e.py --method rotation --layout overlap
+step python -u benchmarks/bench_e2e.py --method rotation --layout overlap --shuffle butterfly
 step python -u benchmarks/bench_e2e.py --method rotation --layout pair
 step python -u benchmarks/bench_e2e.py --method window --layout overlap
 step python -u benchmarks/bench_e2e.py --method exact
@@ -56,4 +48,4 @@ step python -u benchmarks/micro_ops.py --suite gather --iters 10
 step python -u benchmarks/micro_ops.py --suite primitives --iters 10
 
 date | tee -a "$LOG"
-echo "chip suite (continuation 2) complete -> $LOG"
+echo "chip suite (rerun) complete -> $LOG"

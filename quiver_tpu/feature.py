@@ -91,6 +91,7 @@ class Feature:
         self.disk_map = None
         self._gather_cached = None
         self._translate = None
+        self._lookup_cached = None
         self._pool = None              # prefetch staging thread
 
     # -- sizing (reference feature.py:74-82) --------------------------------
@@ -194,12 +195,21 @@ class Feature:
 
         self._gather_cached = jax.jit(gather_cached)
 
+        def lookup_cached(dev_part, ids, order):
+            return gather_cached(dev_part, translate(ids, order))
+
+        # the pure-HBM fast path is ONE dispatch (translate fused into
+        # the gather) — per-call dispatch latency is real when the chip
+        # sits behind a network tunnel
+        self._lookup_cached = jax.jit(lookup_cached)
+
     # -- lookup (reference feature.py:296-333) ------------------------------
     def __getitem__(self, node_idx):
         ids = jnp.asarray(node_idx)
-        ids = self._translate(ids, self.feature_order)
         if self.host_part is None and self.mmap_array is None:
-            return self._gather_cached(self.device_part, ids)
+            return self._lookup_cached(self.device_part, ids,
+                                       self.feature_order)
+        ids = self._translate(ids, self.feature_order)
         # mixed tiers: device rows on device, host/disk rows on host
         if self.device_part is not None:
             out = self._gather_cached(self.device_part, ids)
@@ -296,13 +306,15 @@ class Feature:
     # -- pickling: drop compiled closures, rebuild on load ------------------
     def __getstate__(self):
         state = {k: getattr(self, k) for k in self.__dict__
-                 if k not in ("_gather_cached", "_translate", "_pool")}
+                 if k not in ("_gather_cached", "_translate",
+                              "_lookup_cached", "_pool")}
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._gather_cached = None
         self._translate = None
+        self._lookup_cached = None
         self._pool = None
         self._build_gather()
 

@@ -190,7 +190,8 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
 
 def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                            loss_fn: Callable = cross_entropy_logits,
-                           method: str = "exact"):
+                           method: str = "exact",
+                           indices_stride: int | None = None):
     """Two-phase step for tiered feature stores (the reference's own
     architecture: sampling and feature collection run as separate stages
     around the model, examples/pyg/reddit_quiver.py:116-122):
@@ -206,9 +207,11 @@ def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
 
     @jax.jit
     def sample_fn(indptr, indices, seeds, key, indices_rows=None):
-        n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
-                                       method=method,
-                                       indices_rows=indices_rows)
+        n_id, layers = sample_multihop(
+            indptr, indices, seeds, sizes, key, method=method,
+            indices_rows=indices_rows,
+            indices_stride=indices_stride if indices_rows is not None
+            else None)
         return n_id, layers_to_adjs(layers, batch_size, sizes)
 
     @jax.jit

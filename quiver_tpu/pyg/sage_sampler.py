@@ -236,17 +236,10 @@ class GraphSageSampler:
                     self._rot_eid = smap
             else:
                 permuted = butterfly_shuffle(src, self._row_ids, pkey)
-            if self.mode == "HOST":
-                # HOST mode exists because the E-sized edge array does
-                # not fit HBM; the persistent butterfly state gets the
-                # same host placement as the rows view below
-                try:
-                    sh = jax.sharding.SingleDeviceSharding(
-                        list(permuted.devices())[0],
-                        memory_kind="pinned_host")
-                    permuted = jax.device_put(permuted, sh)
-                except (ValueError, NotImplementedError):
-                    pass
+            # (in HOST mode `permuted` is re-placed on pinned host in
+            # the placement block below, AFTER the rows view is built —
+            # pinning it first would bounce the E-sized array
+            # host->device->host once per epoch)
             self._permuted = permuted
         elif self.with_eid:
             permuted, smap = permute_csr(indices, self._row_ids, pkey,
@@ -261,13 +254,16 @@ class GraphSageSampler:
             # keep the shuffled topology host-resident (the mode exists
             # because indices don't fit HBM); the sampler's row fetches
             # then stream from host like the exact path's. The E-sized
-            # edge-id map gets the same placement for the same reason.
+            # edge-id map and the butterfly's persistent permuted state
+            # get the same placement for the same reason.
             try:
                 sh = jax.sharding.SingleDeviceSharding(
                     list(rows.devices())[0], memory_kind="pinned_host")
                 rows = jax.device_put(rows, sh)
                 if self._rot_eid is not None:
                     self._rot_eid = jax.device_put(self._rot_eid, sh)
+                if self._permuted is not None:
+                    self._permuted = jax.device_put(self._permuted, sh)
             except (ValueError, NotImplementedError):
                 pass
         self._rot = rows
