@@ -47,5 +47,8 @@ step python -u benchmarks/bench_e2e.py --method rotation --layout overlap --bf16
 step python -u benchmarks/micro_ops.py --suite gather --iters 10
 step python -u benchmarks/micro_ops.py --suite primitives --iters 10
 
+# 8. fused-epoch stage ablation (how much of a batch is compaction?)
+step python -u benchmarks/ablate.py
+
 date | tee -a "$LOG"
 echo "chip suite (rerun) complete -> $LOG"

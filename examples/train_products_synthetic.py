@@ -14,6 +14,7 @@ feat, labels, train_idx).
 """
 
 import argparse
+import sys
 import time
 
 import numpy as np
@@ -50,17 +51,40 @@ def main():
                    choices=["device_replicate", "p2p_clique_replicate"],
                    help="p2p_clique_replicate row-shards the hot set over "
                         "all devices (the papers100M-scale layout)")
+    p.add_argument("--sampling", default="exact",
+                   choices=["exact", "rotation", "window"],
+                   help="rotation/window: the wide-row-fetch TPU paths "
+                        "(fused and tiered stores both)")
+    p.add_argument("--layout", default="overlap",
+                   choices=["pair", "overlap"],
+                   help="rotation row layout (overlap = one 256-wide "
+                        "gather per seed, the fastest measured config)")
+    p.add_argument("--shuffle", default="sort",
+                   choices=["sort", "butterfly"],
+                   help="per-epoch row reshuffle (butterfly = ~40x "
+                        "cheaper masked swap network)")
     p.add_argument("--data-parallel", action="store_true",
                    help="shard the batch over all local devices")
     p.add_argument("--npz", default=None)
     args = p.parse_args()
+
+    if args.sampling == "window" and args.shuffle == "butterfly":
+        sys.exit("window+butterfly is statistically unsound for hubs "
+                 "(see GraphSageSampler's rejection of the combo)")
+    if args.sampling == "exact" and (
+            "--shuffle" in sys.argv or "--layout" in sys.argv):
+        sys.exit("--shuffle/--layout only apply to rotation/window "
+                 "sampling; add --sampling rotation (or window) or drop "
+                 "the flag — exact mode would silently ignore it")
 
     import jax
     import jax.numpy as jnp
     import optax
     import quiver_tpu as qv
     from quiver_tpu.models import GraphSAGE
-    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.ops import (as_index_rows, as_index_rows_overlapping,
+                                edge_row_ids, reshuffle_csr,
+                                sample_multihop)
     from quiver_tpu.parallel import make_mesh
     from quiver_tpu.parallel.train import (
         build_e2e_train_step, build_split_train_step, build_train_step,
@@ -116,22 +140,46 @@ def main():
         else jnp.asarray(feature[n_id])
     state = init_state(model, tx, x, adjs, jax.random.key(1))
 
+    # rotation/window state: per-epoch refreshed rows view (+ the
+    # butterfly's composed permuted state)
+    windowed = args.sampling in ("rotation", "window")
+    stride = 128 if (windowed and args.layout == "overlap") else None
+    as_rows = (as_index_rows_overlapping if stride else as_index_rows)
+    row_ids = (jax.jit(edge_row_ids, static_argnums=1)(
+        indptr_j, int(indices_j.shape[0])) if windowed else None)
+    permuted_j = indices_j
+
+    def refresh_rows(epoch):
+        nonlocal permuted_j
+        src = permuted_j if args.shuffle == "butterfly" else indices_j
+        permuted_j = reshuffle_csr(src, row_ids,
+                                   jax.random.key(777_000 + epoch),
+                                   method=args.shuffle)
+        return as_rows(permuted_j)
+
     sample_fn = apply_fn = None
     if not fully_cached:
         if mesh:
             print("NOTE: --data-parallel applies to the fused fully-cached "
                   "path; the tiered-store path runs single-program "
                   "(full batch)")
-        sample_fn, apply_fn = build_split_train_step(model, tx, sizes, bs)
+        sample_fn, apply_fn = build_split_train_step(
+            model, tx, sizes, bs, method=args.sampling,
+            indices_stride=stride)
     elif mesh:
-        step = build_e2e_train_step(model, tx, sizes, per_dev, mesh)
+        step = build_e2e_train_step(model, tx, sizes, per_dev, mesh,
+                                    method=args.sampling,
+                                    indices_stride=stride)
     else:
-        step = build_train_step(model, tx, sizes, per_dev)
+        step = build_train_step(model, tx, sizes, per_dev,
+                                method=args.sampling,
+                                indices_stride=stride)
 
     rng = np.random.default_rng(0)
     it = 0
     for epoch in range(args.epochs):
         perm = rng.permutation(train_idx)
+        rows = refresh_rows(epoch) if windowed else None
         t0 = time.perf_counter()
         epoch_loss, nb = 0.0, 0
         starts = list(range(0, len(perm) - bs + 1, bs))
@@ -139,8 +187,11 @@ def main():
             for lo in starts:
                 seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
                 y = jnp.asarray(labels[perm[lo:lo + bs]])
+                # rows is None in exact mode (permuted_j == indices_j);
+                # every step builder accepts the trailing None
                 state, loss = step(state, feat_j, forder, indptr_j,
-                                   indices_j, seeds, y, jax.random.key(it))
+                                   permuted_j, seeds, y,
+                                   jax.random.key(it), rows)
                 it += 1
                 epoch_loss += float(loss)
                 nb += 1
@@ -150,7 +201,8 @@ def main():
             # thread) while batch i's model step computes
             def stage(lo, k):
                 seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
-                n_id, adjs = sample_fn(indptr_j, indices_j, seeds, k)
+                n_id, adjs = sample_fn(indptr_j, permuted_j, seeds, k,
+                                       rows)
                 return adjs, feature.prefetch(n_id), \
                     jnp.asarray(labels[perm[lo:lo + bs]])
 
