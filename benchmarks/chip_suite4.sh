@@ -9,10 +9,9 @@ LOG=benchmarks/chip_suite.log
 
 date | tee -a "$LOG"
 
-# 1. metric of record + FY window + butterfly secondary (new code)
-step env QT_BENCH_LAYOUT=overlap python -u bench.py
-# butterfly as primary (labeled), for the full-epoch record
-step env QT_BENCH_LAYOUT=overlap QT_BENCH_SHUFFLE=butterfly python -u bench.py
+# 1. metric of record: the full default sweep (pair/sort, overlap/sort,
+#    overlap/butterfly; best wins, labeled) + FY window + exact sides
+step python -u bench.py
 
 # 2. dispatch probe (tiered-100% mystery; now exercises the fused
 #    single-dispatch Feature path)
