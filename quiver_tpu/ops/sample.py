@@ -457,7 +457,7 @@ def _fill_from_run_start(values: jax.Array, at: jax.Array) -> jax.Array:
     return filled
 
 
-def _compact_core(ids: jax.Array, s: int):
+def _compact_core(ids: jax.Array, s: int, seeds_dense: bool = False):
     """Shared sort-only compaction. ``ids[:s]`` is the prefix ("seeds"):
     its valid entries MUST be distinct (duplicate seeds leave holes in the
     slot assignment and corrupt ``n_id`` — same alignment break as the
@@ -465,6 +465,15 @@ def _compact_core(ids: jax.Array, s: int):
     by position (slot = rank among valid seeds, so -1 holes anywhere in
     the prefix are safe); the remaining unique values follow in ascending
     id order.
+
+    ``seeds_dense=True`` promises the valid seeds are exactly the prefix
+    positions [0, v) (valid-first, -1 tail fill — the invariant this
+    function's own ``n_id`` output satisfies, so hop>=1 of a multi-hop
+    expansion can always pass it). Rank-among-valid then equals position,
+    which drops the third operand from the big 2-key sort — the hot
+    hops' main cost. A violating input (interior -1 holes) silently
+    corrupts slot assignment, so only enable it where the invariant is
+    guaranteed by construction.
 
     Returns (n_id [cap] -1-filled, n_count, local [cap]) with ``local[i]``
     = position of ``ids[i]`` in ``n_id`` (garbage where ``ids[i] < 0``).
@@ -487,12 +496,19 @@ def _compact_core(ids: jax.Array, s: int):
     # carry the original position through the sort. A third operand
     # carries each seed's rank among *valid* seeds: seed slots are rank-
     # based so -1 holes in the prefix can't collide with extra slots.
+    # With ``seeds_dense`` rank == position, so the position already in
+    # the tag's low bits serves and the third operand is dropped.
     tag = jnp.where(is_seed, 0, B30) | iota
-    seed_rank = (jnp.cumsum(is_seed).astype(jnp.int32) - 1)
-    sid, stag, srk = jax.lax.sort(
-        (idk, tag, jnp.where(is_seed, seed_rank, 0)), num_keys=2)
+    if seeds_dense:
+        sid, stag = jax.lax.sort((idk, tag), num_keys=2)
+        spos = stag & (B30 - 1)
+        srk = spos
+    else:
+        seed_rank = (jnp.cumsum(is_seed).astype(jnp.int32) - 1)
+        sid, stag, srk = jax.lax.sort(
+            (idk, tag, jnp.where(is_seed, seed_rank, 0)), num_keys=2)
+        spos = stag & (B30 - 1)
     sseed = stag < B30
-    spos = stag & (B30 - 1)
 
     flag = jnp.concatenate(
         [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
@@ -557,7 +573,8 @@ def compact_union(prefix_ids: jax.Array, extra_ids: jax.Array):
     return n_id, n_count, extra_local
 
 
-def compact_layer(seeds: jax.Array, nbrs: jax.Array) -> LayerSample:
+def compact_layer(seeds: jax.Array, nbrs: jax.Array,
+                  seeds_dense: bool = False) -> LayerSample:
     """Deduplicate ``concat(seeds, nbrs)`` and emit the layer's bipartite
     COO in local (compacted) ids.
 
@@ -566,11 +583,14 @@ def compact_layer(seeds: jax.Array, nbrs: jax.Array) -> LayerSample:
     fill. Output capacity is the static ``s + s*k``. Valid seeds keep
     slots [0, n_valid_seeds) of ``n_id`` (the invariant training relies
     on: layer outputs for the batch are rows [0, bs)); new neighbors
-    follow in ascending id order.
+    follow in ascending id order. ``seeds_dense`` promises valid seeds
+    are a prefix (see ``_compact_core``) — true whenever ``seeds`` is a
+    previous hop's ``n_id``; drops one operand from the big sort.
     """
     s, k = nbrs.shape
     n_id, n_count, local_ids = _compact_core(
-        jnp.concatenate([seeds, nbrs.reshape(-1)]), s)
+        jnp.concatenate([seeds, nbrs.reshape(-1)]), s,
+        seeds_dense=seeds_dense)
     nbr_valid = nbrs.reshape(-1) >= 0
     col = jnp.where(nbr_valid, local_ids[s:], -1)
     seed_local = jax.lax.broadcast_in_dim(

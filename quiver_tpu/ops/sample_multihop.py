@@ -101,7 +101,10 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
         nbrs = out[0]
         if track_eid:
             slots = out[2]
-        layer = compact_layer(cur, nbrs)
+        # hop >= 1 seeds are the previous hop's n_id — valid-first by
+        # _compact_core's own output invariant — so the cheaper dense
+        # seed path is always safe there
+        layer = compact_layer(cur, nbrs, seeds_dense=(i > 0))
         if track_eid:
             flat = slots.reshape(-1)
             if eid is True:

@@ -373,6 +373,56 @@ class TestRotationSampler:
                 sorted(indices[lo:hi].tolist())
 
 
+class TestCompactDenseSeeds:
+    def test_dense_path_matches_general(self, rng):
+        # valid-first prefix (a previous hop's n_id shape): the dense
+        # fast path must produce identical outputs to the general path
+        from quiver_tpu.ops.sample import _compact_core
+        for trial in range(4):
+            v = int(rng.integers(1, 40))
+            s = 48
+            seeds = np.full(s, -1, np.int32)
+            seeds[:v] = rng.choice(5000, v, replace=False)
+            extras = rng.integers(-1, 5000, 300).astype(np.int32)
+            ids = jnp.asarray(np.concatenate([seeds, extras]))
+            a = _compact_core(ids, s, seeds_dense=False)
+            b = _compact_core(ids, s, seeds_dense=True)
+            for x, y, name in zip(a, b, ("n_id", "n_count", "local")):
+                if name == "local":
+                    # local is garbage where ids < 0; compare valid only
+                    m = np.asarray(ids) >= 0
+                    np.testing.assert_array_equal(
+                        np.asarray(x)[m], np.asarray(y)[m], err_msg=name)
+                else:
+                    np.testing.assert_array_equal(
+                        np.asarray(x), np.asarray(y), err_msg=name)
+
+    def test_multihop_matches_pre_dense_behavior(self, small_graph):
+        # the multihop output contract is unchanged by the hop>=1 dense
+        # path: membership + seed-slot invariants hold
+        from quiver_tpu.ops import sample_multihop
+        indptr, indices = small_graph
+        seeds = np.arange(24, dtype=np.int32)
+        n_id, layers = jax.jit(
+            lambda a, b, c, k: sample_multihop(a, b, c, [5, 4, 3], k)
+        )(jnp.asarray(indptr), jnp.asarray(indices), jnp.asarray(seeds),
+          KEY)
+        nsets = neighbor_sets(indptr, indices)
+        prev = seeds
+        for lay in layers:
+            nid = np.asarray(lay.n_id)
+            cnt = int(lay.n_count)
+            # valid-first, seeds keep their slots
+            assert (nid[:cnt] >= 0).all() and (nid[cnt:] == -1).all()
+            pv = prev[prev >= 0]
+            np.testing.assert_array_equal(nid[: len(pv)], pv)
+            row, col = np.asarray(lay.row), np.asarray(lay.col)
+            m = col >= 0
+            for r, c in zip(row[m], col[m]):
+                assert nid[c] in nsets[nid[r]]
+            prev = nid
+
+
 class TestButterflyShuffle:
     """butterfly_shuffle: the cheap per-epoch re-mix must preserve CSR
     structure exactly and actually mix within rows."""
