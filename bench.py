@@ -219,7 +219,8 @@ def main():
                                             jax.random.fold_in(kbatch, i),
                                             method=method,
                                             indices_rows=rows,
-                                            indices_stride=stride)
+                                            indices_stride=stride,
+                                            seeds_dense=True)
                 edges = sum(l.edge_count.astype(jnp.int32) for l in layers)
                 return total + edges, None
             total, _ = jax.lax.scan(

@@ -557,7 +557,9 @@ def compact_ids(ids: jax.Array):
     ``ids[i]`` in ``n_id`` (garbage where ``ids[i] < 0``). ``n_id`` lists
     the unique values in ascending order. Sort-only replacement for the
     reference's device ordered hashtable (reindex.cu.hpp:20-183)."""
-    return _compact_core(ids, 0)
+    # s=0: no seed prefix, so the dense promise holds vacuously and the
+    # rank operand is never read — take the 2-operand sort
+    return _compact_core(ids, 0, seeds_dense=True)
 
 
 def compact_union(prefix_ids: jax.Array, extra_ids: jax.Array):

@@ -22,6 +22,7 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                     indices_rows: jax.Array | None = None,
                     eid=None,
                     indices_stride: int | None = None,
+                    seeds_dense: bool = False,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
@@ -50,6 +51,12 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     ``indices_stride``: set to the build width (128) when
     ``indices_rows`` came from ``as_index_rows_overlapping`` — rotation
     then does ONE row gather per seed instead of two (2x index memory).
+
+    ``seeds_dense`` promises the hop-0 ``seeds`` are valid-first (-1
+    fill only at the tail, e.g. a raw training batch with no padding or
+    a ``compact_ids`` output) — drops one operand from hop 0's
+    compaction sort. Hops >= 1 always take that path (their seeds are
+    the previous hop's ``n_id``, valid-first by construction).
 
     ``eid`` enables per-edge id tracking (off by default — it adds one
     scattered gather per sampled edge, which the fused training path
@@ -103,8 +110,9 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
             slots = out[2]
         # hop >= 1 seeds are the previous hop's n_id — valid-first by
         # _compact_core's own output invariant — so the cheaper dense
-        # seed path is always safe there
-        layer = compact_layer(cur, nbrs, seeds_dense=(i > 0))
+        # seed path is always safe there; hop 0 takes it only when the
+        # caller promises a valid-first batch (``seeds_dense``)
+        layer = compact_layer(cur, nbrs, seeds_dense=(i > 0) or seeds_dense)
         if track_eid:
             flat = slots.reshape(-1)
             if eid is True:
@@ -130,6 +138,7 @@ def sample_multihop_dedup(indptr: jax.Array, indices: jax.Array,
     from .sample import compact_ids
 
     ubatch, _, blocals = compact_ids(batch.astype(jnp.int32))
+    kwargs.setdefault("seeds_dense", True)   # compact_ids output is dense
     n_id, layers = sample_multihop(indptr, indices, ubatch, sizes, key,
                                    **kwargs)
     return n_id, layers, blocals

@@ -71,10 +71,17 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
     ``masked_feature_gather``; the multi-host fused step substitutes the
     partitioned all_to_all lookup. Everything else (sampling keys, the
     dropout fold constant, the logits slice) is THE shared definition —
-    dist/DP loss parity depends on there being exactly one copy."""
+    dist/DP loss parity depends on there being exactly one copy.
+
+    Batch contract: ``seeds`` must be distinct valid ids with -1 padding
+    at the TAIL only. That was always required here — ``labels`` are
+    indexed by batch position while interior holes would shift seeds to
+    rank-based output rows, silently misaligning the loss — so hop 0
+    also takes the cheaper dense-seed compaction path."""
     n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
                                    method=method, indices_rows=indices_rows,
-                                   indices_stride=indices_stride)
+                                   indices_stride=indices_stride,
+                                   seeds_dense=True)
     x = (gather or masked_feature_gather)(feat, n_id, forder)
     adjs = layers_to_adjs(layers, batch_size, sizes)
     logits = model.apply(params, x, adjs, train=True,
@@ -207,11 +214,13 @@ def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
 
     @jax.jit
     def sample_fn(indptr, indices, seeds, key, indices_rows=None):
+        # same batch contract as _fused_loss: distinct valid ids,
+        # -1 padding at the tail only (labels are position-indexed)
         n_id, layers = sample_multihop(
             indptr, indices, seeds, sizes, key, method=method,
             indices_rows=indices_rows,
             indices_stride=indices_stride if indices_rows is not None
-            else None)
+            else None, seeds_dense=True)
         return n_id, layers_to_adjs(layers, batch_size, sizes)
 
     @jax.jit
