@@ -17,6 +17,7 @@ from .sample_multihop import sample_multihop, sample_multihop_dedup
 from .random_walk import random_walk, random_walk_step
 from .weighted import (
     sample_layer_weighted,
+    sample_layer_weighted_window,
     csr_weights_from_eid,
 )
 
@@ -38,6 +39,7 @@ __all__ = [
     "random_walk",
     "random_walk_step",
     "sample_layer_weighted",
+    "sample_layer_weighted_window",
     "csr_weights_from_eid",
     "LayerSample",
 ]

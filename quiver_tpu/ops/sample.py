@@ -142,7 +142,8 @@ def edge_row_ids(indptr: jax.Array, edge_count: int) -> jax.Array:
 
 
 def permute_csr(indices: jax.Array, row_ids: jax.Array,
-                key: jax.Array, with_slot_map: bool = False):
+                key: jax.Array, with_slot_map: bool = False,
+                extra=None):
     """Uniformly shuffle every CSR row's neighbor list, on device, in one
     2-key sort over the edge array. O(E log E), ~4ms per 1M edges on
     v5e — refresh once per epoch so rotation sampling (below) draws fresh
@@ -150,21 +151,33 @@ def permute_csr(indices: jax.Array, row_ids: jax.Array,
 
     With ``with_slot_map`` also returns ``slot_map`` where
     ``slot_map[p]`` = the ORIGINAL CSR slot now stored at permuted
-    position ``p`` (feeds edge-id tracking under rotation sampling)."""
+    position ``p`` (feeds edge-id tracking under rotation sampling).
+
+    ``extra``: optional tuple of CSR-slot-aligned arrays (e.g. edge
+    weights) co-permuted as additional sort payloads — far cheaper than
+    an E-sized ``arr[slot_map]`` gather after the fact. Returns
+    ``(permuted, extras_tuple[, slot_map])`` when given."""
     rand = jax.random.bits(key, (indices.shape[0],)).astype(jnp.int32)
+    ops = [row_ids, rand, indices.astype(jnp.int32)]
+    ops += [jnp.asarray(x) for x in (extra or ())]
     if with_slot_map:
-        iota = jnp.arange(indices.shape[0], dtype=jnp.int32)
-        _, _, permuted, slot_map = jax.lax.sort(
-            (row_ids, rand, indices.astype(jnp.int32), iota), num_keys=2)
-        return permuted, slot_map
-    _, _, permuted = jax.lax.sort(
-        (row_ids, rand, indices.astype(jnp.int32)), num_keys=2)
+        ops.append(jnp.arange(indices.shape[0], dtype=jnp.int32))
+    out = jax.lax.sort(tuple(ops), num_keys=2)
+    permuted = out[2]
+    n_extra = len(extra) if extra is not None else 0
+    extras = tuple(out[3:3 + n_extra])
+    if with_slot_map and extra is not None:
+        return permuted, extras, out[-1]
+    if with_slot_map:
+        return permuted, out[-1]
+    if extra is not None:
+        return permuted, extras
     return permuted
 
 
 def butterfly_shuffle(indices: jax.Array, row_ids: jax.Array,
                       key: jax.Array, with_slot_map: bool = False,
-                      max_stride: int = 128):
+                      max_stride: int = 128, extra=None):
     """Cheap per-epoch within-row re-mix: a masked butterfly network.
 
     ``permute_csr`` (exact uniform per-row shuffle) costs a 2-key sort
@@ -195,10 +208,16 @@ def butterfly_shuffle(indices: jax.Array, row_ids: jax.Array,
     ``permute_csr`` whose input is always the original CSR order. Under
     the feed-output-back-in composition, edge-id tracking must compose
     maps across epochs: ``running = running[slot_map_this_epoch]``.
+
+    ``extra``: optional tuple of slot-aligned arrays (e.g. edge weights)
+    carried through the same swaps; returned as
+    ``(out, extras_tuple[, slot_map])`` — compose them across epochs by
+    feeding the outputs back in, like ``indices`` itself.
     """
     e = indices.shape[0]
     out = indices.astype(jnp.int32)
     payload = (jnp.arange(e, dtype=jnp.int32) if with_slot_map else None)
+    extras = [jnp.asarray(x) for x in (extra or ())]
     kphi, kcoin = jax.random.split(key)
     # phase-roll so pairing-block alignment differs per epoch
     phi = jax.random.randint(kphi, (), 0, e, dtype=jnp.int32)
@@ -206,6 +225,7 @@ def butterfly_shuffle(indices: jax.Array, row_ids: jax.Array,
     rows = jnp.roll(row_ids, phi)
     if payload is not None:
         payload = jnp.roll(payload, phi)
+    extras = [jnp.roll(x, phi) for x in extras]
 
     s = 1
     pass_i = 0
@@ -231,28 +251,36 @@ def butterfly_shuffle(indices: jax.Array, row_ids: jax.Array,
         out = swap(out, -1)
         if payload is not None:
             payload = swap(payload, -1)
+        extras = [swap(x, 0) for x in extras]
         s *= 2
         pass_i += 1
 
     out = jnp.roll(out, -phi)
+    ext_out = tuple(jnp.roll(x, -phi) for x in extras)
+    if payload is not None and extra is not None:
+        return out, ext_out, jnp.roll(payload, -phi)
     if payload is not None:
         return out, jnp.roll(payload, -phi)
+    if extra is not None:
+        return out, ext_out
     return out
 
 
 def reshuffle_csr(indices: jax.Array, row_ids: jax.Array, key: jax.Array,
-                  method: str = "sort", with_slot_map: bool = False):
+                  method: str = "sort", with_slot_map: bool = False,
+                  extra=None):
     """Per-epoch row-order refresh for rotation/window sampling:
     ``method="sort"`` = ``permute_csr`` (exact uniform per-row shuffle,
     O(E log E) sort), ``"butterfly"`` = ``butterfly_shuffle`` (~40x
     cheaper masked swap network; composes toward uniform over epochs —
-    feed each epoch's output into the next call)."""
+    feed each epoch's output into the next call). ``extra`` co-permutes
+    slot-aligned arrays (e.g. edge weights) alongside."""
     if method == "sort":
         return permute_csr(indices, row_ids, key,
-                           with_slot_map=with_slot_map)
+                           with_slot_map=with_slot_map, extra=extra)
     if method == "butterfly":
         return butterfly_shuffle(indices, row_ids, key,
-                                 with_slot_map=with_slot_map)
+                                 with_slot_map=with_slot_map, extra=extra)
     raise ValueError(f"unknown reshuffle method {method!r}")
 
 

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from .sample import (LayerSample, as_index_rows, as_index_rows_overlapping,
                      compact_layer, edge_rows, permute_csr, sample_layer,
                      sample_layer_rotation, sample_layer_window)
-from .weighted import sample_layer_weighted
+from .weighted import sample_layer_weighted, sample_layer_weighted_window
 
 
 def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
@@ -23,6 +23,7 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                     eid=None,
                     indices_stride: int | None = None,
                     seeds_dense: bool = False,
+                    weight_rows: jax.Array | None = None,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
@@ -46,7 +47,12 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     still marginally uniform — correct but slower per call; callers on
     the hot path should shuffle per epoch themselves.
     ``edge_weight`` (CSR-slot-aligned) switches every hop to weighted
-    sampling (always exact).
+    sampling — the exact [bs, row_cap] pool draw by default; with a
+    windowed ``method`` AND ``weight_rows`` (the weight layout from the
+    same shuffle: ``reshuffle_csr(..., extra=(edge_weight,))`` then
+    ``as_index_rows*``), hops use the ~8x-cheaper windowed weighted
+    draw instead (``sample_layer_weighted_window``'s truncation
+    caveats apply).
 
     ``indices_stride``: set to the build width (128) when
     ``indices_rows`` came from ``as_index_rows_overlapping`` — rotation
@@ -91,7 +97,16 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     for i, k in enumerate(sizes):
         sub = jax.random.fold_in(key, i)
         slots = None
-        if edge_weight is not None:
+        if edge_weight is not None and windowed and weight_rows is not None:
+            if indices_rows is None:
+                raise ValueError(
+                    "windowed weighted sampling needs indices_rows from "
+                    "the same shuffle as weight_rows (reshuffle_csr with "
+                    "extra=(edge_weight,), then as_index_rows* both)")
+            out = sample_layer_weighted_window(
+                indptr, indices_rows, weight_rows, cur, k, sub,
+                stride=indices_stride, with_slots=track_eid)
+        elif edge_weight is not None:
             out = sample_layer_weighted(indptr, indices, edge_weight,
                                         cur, k, sub, with_slots=track_eid)
         elif method == "rotation":

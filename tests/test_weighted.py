@@ -8,9 +8,126 @@ import pytest
 
 import quiver_tpu as qv
 from quiver_tpu.ops import (
-    csr_weights_from_eid, sample_layer_weighted)
+    as_index_rows, as_index_rows_overlapping, csr_weights_from_eid,
+    edge_row_ids, reshuffle_csr, sample_layer_weighted,
+    sample_layer_weighted_window)
 
 KEY = jax.random.key(0)
+
+
+def _window_setup(indptr, indices, w, key, method="sort", overlap=True):
+    """Shuffle indices+weights together and build both row layouts."""
+    row_ids = edge_row_ids(jnp.asarray(indptr), len(indices))
+    permuted, (wp,) = reshuffle_csr(jnp.asarray(indices), row_ids, key,
+                                    method=method,
+                                    extra=(jnp.asarray(w),))
+    as_rows = as_index_rows_overlapping if overlap else as_index_rows
+    return as_rows(permuted), as_rows(wp), (128 if overlap else None)
+
+
+class TestWeightedWindow:
+    def test_distribution_follows_weights(self):
+        indptr = np.array([0, 4])
+        indices = np.arange(4, dtype=np.int32)
+        w = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        seeds = jnp.zeros((2048,), jnp.int32)
+        hits = np.zeros(4)
+        for t in range(10):
+            irows, wrows, stride = _window_setup(
+                indptr, indices, w, jax.random.key(50 + t))
+            nbrs, counts = sample_layer_weighted_window(
+                jnp.asarray(indptr), irows, wrows, seeds, 2,
+                jax.random.fold_in(KEY, t), stride=stride)
+            # weights follow their neighbor through the shuffle: the
+            # drawn ids must still be weight-distributed
+            ids, cnt = np.unique(np.asarray(nbrs), return_counts=True)
+            hits[ids] += cnt
+        freq = hits / hits.sum()
+        np.testing.assert_allclose(freq, w / w.sum(), atol=0.01)
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    def test_membership_counts_and_masks(self, small_graph, rng, overlap):
+        indptr, indices = small_graph
+        w = rng.random(len(indices)).astype(np.float32) + 0.1
+        seeds = np.concatenate([np.arange(len(indptr) - 1, dtype=np.int32),
+                                [-1, -1]])
+        k = 5
+        irows, wrows, stride = _window_setup(
+            indptr, indices, w, jax.random.key(9), overlap=overlap)
+        nbrs, counts = sample_layer_weighted_window(
+            jnp.asarray(indptr), irows, wrows, jnp.asarray(seeds), k, KEY,
+            stride=stride)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        deg = np.diff(indptr)
+        np.testing.assert_array_equal(counts[:len(deg)],
+                                      np.minimum(deg, k))
+        np.testing.assert_array_equal(counts[len(deg):], 0)
+        assert (nbrs[len(deg):] == -1).all()
+        from tests.test_sample_ops import neighbor_sets
+        nsets = neighbor_sets(indptr, indices)
+        for i in range(len(deg)):
+            got = nbrs[i][nbrs[i] >= 0]
+            assert len(got) == counts[i]
+            assert set(got.tolist()) <= nsets[i]
+
+    def test_zero_mass_row_masked(self):
+        indptr = np.array([0, 3])
+        indices = np.arange(3, dtype=np.int32)
+        w = np.zeros(3, np.float32)
+        irows, wrows, stride = _window_setup(indptr, indices, w,
+                                             jax.random.key(1))
+        nbrs, counts = sample_layer_weighted_window(
+            jnp.asarray(indptr), irows, wrows, jnp.zeros((4,), jnp.int32),
+            2, KEY, stride=stride)
+        assert (np.asarray(nbrs) == -1).all()
+        assert (np.asarray(counts) == 0).all()
+
+    def test_slots_name_permuted_positions(self, small_graph, rng):
+        indptr, indices = small_graph
+        w = rng.random(len(indices)).astype(np.float32) + 0.1
+        row_ids = edge_row_ids(jnp.asarray(indptr), len(indices))
+        permuted, (wp,), smap = reshuffle_csr(
+            jnp.asarray(indices), row_ids, jax.random.key(2),
+            with_slot_map=True, extra=(jnp.asarray(w),))
+        irows = as_index_rows_overlapping(permuted)
+        wrows = as_index_rows_overlapping(wp)
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        nbrs, counts, slots = sample_layer_weighted_window(
+            jnp.asarray(indptr), irows, wrows, jnp.asarray(seeds), 3, KEY,
+            stride=128, with_slots=True)
+        nbrs, slots = np.asarray(nbrs), np.asarray(slots)
+        perm_np = np.asarray(permuted)
+        m = nbrs >= 0
+        np.testing.assert_array_equal(perm_np[slots[m]], nbrs[m])
+        # original CSR slots via the slot map still hold the same ids
+        orig = np.asarray(indices)[np.asarray(smap)[slots[m]]]
+        np.testing.assert_array_equal(orig, nbrs[m])
+
+    def test_multihop_windowed_weighted_wiring(self, small_graph, rng):
+        from quiver_tpu.ops import sample_multihop
+        indptr, indices = small_graph
+        w = rng.random(len(indices)).astype(np.float32) + 0.1
+        irows, wrows, stride = _window_setup(indptr, indices, w,
+                                             jax.random.key(3))
+        seeds = jnp.asarray(np.arange(16, dtype=np.int32))
+        n_id, layers = sample_multihop(
+            jnp.asarray(indptr), jnp.asarray(indices), seeds, [4, 3], KEY,
+            edge_weight=jnp.asarray(w), method="rotation",
+            indices_rows=irows, weight_rows=wrows, indices_stride=stride)
+        from tests.test_sample_ops import neighbor_sets
+        nsets = neighbor_sets(indptr, indices)
+        nid = np.asarray(n_id)
+        for lay in layers:
+            row, col = np.asarray(lay.row), np.asarray(lay.col)
+            lnid = np.asarray(lay.n_id)
+            m = col >= 0
+            for r, c in zip(row[m], col[m]):
+                assert lnid[c] in nsets[lnid[r]]
+        with pytest.raises(ValueError, match="same shuffle"):
+            sample_multihop(
+                jnp.asarray(indptr), jnp.asarray(indices), seeds, [4, 3],
+                KEY, edge_weight=jnp.asarray(w), method="rotation",
+                weight_rows=wrows, indices_stride=stride)
 
 
 class TestWeightedLayer:
