@@ -75,6 +75,13 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     cur = seeds.astype(jnp.int32)
     track_eid = eid is not None
     windowed = method in ("rotation", "window")
+    if weight_rows is not None and (edge_weight is None or not windowed):
+        # the coupled-parameter mistake in the other direction: a built
+        # weight layout that the dispatch below would silently ignore
+        raise ValueError(
+            "weight_rows is only consumed by windowed WEIGHTED sampling "
+            "— pass edge_weight (the trigger) and a rotation/window "
+            "method with it, or drop it")
     if edge_weight is None and windowed and indices_rows is None:
         # the no-arg fallback must not sample consecutive runs of the
         # caller's (possibly raw CSR) order — that permanently

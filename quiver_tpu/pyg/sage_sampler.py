@@ -121,9 +121,12 @@ class GraphSageSampler:
         # so draws stay marginally uniform.
         if sampling not in ("exact", "rotation", "window"):
             raise ValueError(f"unknown sampling method {sampling!r}")
-        if sampling in ("rotation", "window") and (
-                edge_weight is not None or mode == "CPU"):
-            sampling = "exact"   # those paths have their own samplers
+        if sampling in ("rotation", "window") and mode == "CPU":
+            sampling = "exact"   # the CPU engine has its own sampler
+        # weighted + rotation/window = the windowed weighted draw
+        # (sample_layer_weighted_window): weight-exact for deg <= 129,
+        # in-window renormalization bias on hubs (see its docstring) —
+        # an explicit caller choice, not a silent fallback
         if sampling in ("rotation", "window") and \
                 max(sizes, default=0) > 128:
             raise ValueError(
@@ -145,7 +148,9 @@ class GraphSageSampler:
             raise ValueError(f"unknown rotation layout {layout!r}")
         if shuffle not in ("sort", "butterfly"):
             raise ValueError(f"unknown shuffle {shuffle!r}")
-        if shuffle == "butterfly" and sampling == "window":
+        if shuffle == "butterfly" and (
+                sampling == "window" or
+                (edge_weight is not None and sampling == "rotation")):
             # window anchors its ~256-entry window at the segment start
             # and relies on the reshuffle to re-place hub neighbors
             # uniformly; butterfly moves an element <= 255 positions per
@@ -153,18 +158,21 @@ class GraphSageSampler:
             # many epochs — silent sampling bias. Rotation is safe (its
             # random offset walks the whole segment every draw).
             raise ValueError(
-                "shuffle='butterfly' cannot provide window sampling's "
-                "mandatory hub re-placement (bounded per-epoch "
-                "displacement); use shuffle='sort' with window mode, or "
-                "sampling='rotation' with butterfly")
+                "shuffle='butterfly' cannot provide the anchored-window "
+                "draws' mandatory hub re-placement (bounded per-epoch "
+                "displacement): window mode and weighted rotation/window "
+                "both anchor at the segment start; use shuffle='sort' "
+                "there (unweighted rotation works with butterfly)")
         self.layout = layout
         self.shuffle = shuffle
         self._key = jax.random.key(seed)
         self._placed = None
         self._weight_placed = None
         self._rot = None          # shuffled row view (pair or overlap)
+        self._rot_w = None        # co-shuffled weight row view
         self._rot_eid = None      # slot->edge-id map in permuted coords
         self._permuted = None     # flat permuted indices (butterfly state)
+        self._permuted_w = None   # flat co-permuted weights (butterfly)
         self._row_ids = None
         self._fns = {}
 
@@ -222,34 +230,63 @@ class GraphSageSampler:
                 indptr, int(indices.shape[0]))
         pkey = key if key is not None else self.next_key()
         base = self.csr_topo.eid if self.with_eid else None
-        if self.shuffle == "butterfly":
+        weighted = self.edge_weight is not None
+        bfly = self.shuffle == "butterfly"
+        if weighted and self._weight_placed is None:
+            self._weight_placed = jnp.asarray(self.edge_weight)
+            if self.mode == "HOST":
+                # HOST mode = E-sized arrays don't fit HBM; the weight
+                # array is as big as indices and gets the same placement
+                try:
+                    sh = jax.sharding.SingleDeviceSharding(
+                        list(self._weight_placed.devices())[0],
+                        memory_kind="pinned_host")
+                    self._weight_placed = jax.device_put(
+                        self._weight_placed, sh)
+                except (ValueError, NotImplementedError):
+                    pass
+        if bfly:
+            # composed state: feed the previous epoch's outputs back in
             src = self._permuted if self._permuted is not None else indices
-            if self.with_eid:
-                permuted, smap = butterfly_shuffle(
-                    src, self._row_ids, pkey, with_slot_map=True)
-                # smap is input-relative: compose with the running map
-                if self._rot_eid is not None:
-                    self._rot_eid = self._rot_eid[smap]
-                elif base is not None:
-                    self._rot_eid = jnp.asarray(base)[smap]
-                else:
-                    self._rot_eid = smap
+            wsrc = (self._permuted_w if self._permuted_w is not None
+                    else self._weight_placed)
+        else:
+            src, wsrc = indices, self._weight_placed
+        extra = (wsrc,) if weighted else None
+        fn = butterfly_shuffle if bfly else permute_csr
+        out = fn(src, self._row_ids, pkey, with_slot_map=self.with_eid,
+                 extra=extra)
+        wp = None
+        if self.with_eid and weighted:
+            permuted, (wp,), smap = out
+        elif self.with_eid:
+            permuted, smap = out
+        elif weighted:
+            permuted, (wp,) = out
+        else:
+            permuted = out
+        if self.with_eid:
+            if not bfly:
+                self._rot_eid = (smap if base is None
+                                 else jnp.asarray(base)[smap])
+            # butterfly smap is input-relative: compose the running map
+            elif self._rot_eid is not None:
+                self._rot_eid = self._rot_eid[smap]
+            elif base is not None:
+                self._rot_eid = jnp.asarray(base)[smap]
             else:
-                permuted = butterfly_shuffle(src, self._row_ids, pkey)
-            # (in HOST mode `permuted` is re-placed on pinned host in
-            # the placement block below, AFTER the rows view is built —
-            # pinning it first would bounce the E-sized array
+                self._rot_eid = smap
+        if bfly:
+            # (in HOST mode these are re-placed on pinned host in the
+            # placement block below, AFTER the rows views are built —
+            # pinning first would bounce E-sized arrays
             # host->device->host once per epoch)
             self._permuted = permuted
-        elif self.with_eid:
-            permuted, smap = permute_csr(indices, self._row_ids, pkey,
-                                         with_slot_map=True)
-            self._rot_eid = (smap if base is None
-                             else jnp.asarray(base)[smap])
-        else:
-            permuted = permute_csr(indices, self._row_ids, pkey)
-        rows = (as_index_rows_overlapping(permuted)
-                if self.layout == "overlap" else as_index_rows(permuted))
+            self._permuted_w = wp
+        as_rows = (as_index_rows_overlapping if self.layout == "overlap"
+                   else as_index_rows)
+        rows = as_rows(permuted)
+        self._rot_w = as_rows(wp) if weighted else None
         if self.mode == "HOST":
             # keep the shuffled topology host-resident (the mode exists
             # because indices don't fit HBM); the sampler's row fetches
@@ -260,10 +297,14 @@ class GraphSageSampler:
                 sh = jax.sharding.SingleDeviceSharding(
                     list(rows.devices())[0], memory_kind="pinned_host")
                 rows = jax.device_put(rows, sh)
+                if self._rot_w is not None:
+                    self._rot_w = jax.device_put(self._rot_w, sh)
                 if self._rot_eid is not None:
                     self._rot_eid = jax.device_put(self._rot_eid, sh)
                 if self._permuted is not None:
                     self._permuted = jax.device_put(self._permuted, sh)
+                if self._permuted_w is not None:
+                    self._permuted_w = jax.device_put(self._permuted_w, sh)
             except (ValueError, NotImplementedError):
                 pass
         self._rot = rows
@@ -284,7 +325,7 @@ class GraphSageSampler:
         stride = 128 if self.layout == "overlap" else None
 
         def run(indptr, indices, seeds, key, weights=None, rows=None,
-                eid_arr=None):
+                eid_arr=None, w_rows=None):
             from ..ops.sample_multihop import sample_multihop
             eid = {"none": None, "slots": True, "map": eid_arr}[eid_mode]
             return sample_multihop(indptr, indices, seeds, sizes, key,
@@ -292,7 +333,8 @@ class GraphSageSampler:
                                    method=method, indices_rows=rows,
                                    eid=eid,
                                    indices_stride=stride if rows is not None
-                                   else None)
+                                   else None,
+                                   weight_rows=w_rows)
 
         return jax.jit(run)
 
@@ -323,15 +365,16 @@ class GraphSageSampler:
             if self._rot is None:
                 self.reshuffle()
             rows = self._rot
+            w_rows = self._rot_w
             eid_arr = self._rot_eid
         else:
-            rows = None
+            rows = w_rows = None
             eid_arr = (jnp.asarray(self.csr_topo.eid)
                        if self.with_eid and self.csr_topo.eid is not None
                        else None)
         n_id, layers = fn(jnp.asarray(indptr), jnp.asarray(indices),
                           seeds, self.next_key(), self._weight_placed, rows,
-                          eid_arr)
+                          eid_arr, w_rows)
         shapes = layer_shapes(bs, self.sizes)
         adjs = []
         for layer, shape in zip(layers, shapes):

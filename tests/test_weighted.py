@@ -103,6 +103,35 @@ class TestWeightedWindow:
         orig = np.asarray(indices)[np.asarray(smap)[slots[m]]]
         np.testing.assert_array_equal(orig, nbrs[m])
 
+    def test_sampler_weighted_rotation_end_to_end(self, rng):
+        # GraphSageSampler: weighted + rotation = windowed weighted draws
+        # with co-shuffled weight rows, eids surviving reshuffles
+        n, e = 120, 900
+        coo = rng.integers(0, n, (2, e))
+        topo = qv.CSRTopo(edge_index=coo, node_count=n)
+        w_coo = (rng.random(e).astype(np.float32) + 0.1)
+        w_csr = csr_weights_from_eid(jnp.asarray(topo.eid),
+                                     jnp.asarray(w_coo))
+        sampler = qv.GraphSageSampler(topo, sizes=[4, 3],
+                                      edge_weight=w_csr,
+                                      sampling="rotation",
+                                      layout="overlap", with_eid=True)
+        assert sampler.sampling == "rotation"   # no silent exact fallback
+        seeds = rng.choice(n, 16, replace=False)
+        from tests.test_sampler_api import check_eids
+        for _ in range(2):
+            n_id, bs, adjs = sampler.sample(seeds)
+            check_eids(coo, n_id, adjs)
+            sampler.reshuffle()
+
+    def test_sampler_weighted_rotation_butterfly_rejected(self, rng):
+        coo, = (rng.integers(0, 50, (2, 300)),)
+        topo = qv.CSRTopo(edge_index=coo, node_count=50)
+        w = jnp.ones((300,), jnp.float32)
+        with pytest.raises(ValueError, match="butterfly"):
+            qv.GraphSageSampler(topo, [4], edge_weight=w,
+                                sampling="rotation", shuffle="butterfly")
+
     def test_multihop_windowed_weighted_wiring(self, small_graph, rng):
         from quiver_tpu.ops import sample_multihop
         indptr, indices = small_graph
