@@ -29,6 +29,11 @@ def main():
     p.add_argument("--classes", type=int, default=5)
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--sampling", default="exact",
+                   choices=["exact", "rotation"],
+                   help="rotation = the windowed weighted draw (wide "
+                        "row fetches over co-shuffled index/weight "
+                        "layouts; weight-exact for deg <= 129)")
     args = p.parse_args()
 
     import jax
@@ -66,9 +71,13 @@ def main():
     indices_j = jnp.asarray(topo.indices)
     feat_j = jnp.asarray(feat)
 
-    def fused_loss(params, weights, seeds, y, key):
-        n_id, layers = sample_multihop(indptr_j, indices_j, seeds, sizes,
-                                       key, edge_weight=weights)
+    windowed = args.sampling == "rotation"
+
+    def fused_loss(params, weights, seeds, y, key, rows, w_rows):
+        n_id, layers = sample_multihop(
+            indptr_j, indices_j, seeds, sizes, key, edge_weight=weights,
+            method=args.sampling, indices_rows=rows, weight_rows=w_rows,
+            indices_stride=128 if windowed else None)
         x = masked_feature_gather(feat_j, n_id)
         adjs = layers_to_adjs(layers, bs, sizes)
         logits = model.apply(params, x, adjs)[:bs]
@@ -76,12 +85,26 @@ def main():
             logits, y).mean()
 
     @jax.jit
-    def step(state, weights, seeds, y, key):
+    def step(state, weights, seeds, y, key, rows=None, w_rows=None):
         loss, grads = jax.value_and_grad(fused_loss)(
-            state.params, weights, seeds, y, key)
+            state.params, weights, seeds, y, key, rows, w_rows)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         return TrainState(optax.apply_updates(state.params, updates),
                           opt_state, state.step + 1), loss
+
+    from quiver_tpu.ops import (as_index_rows_overlapping, edge_row_ids,
+                                reshuffle_csr)
+    rids = jax.jit(edge_row_ids, static_argnums=1)(indptr_j, e) \
+        if windowed else None
+
+    def shuffled_views(weights, key):
+        """Co-shuffle indices+weights and build the overlap layouts
+        (refresh per epoch AND after every weight update — the weight
+        rows must mirror the current weights)."""
+        permuted, (wp,) = reshuffle_csr(indices_j, rids, key,
+                                        extra=(weights,))
+        return (as_index_rows_overlapping(permuted),
+                as_index_rows_overlapping(wp))
 
     # init
     seeds0 = jnp.arange(bs, dtype=jnp.int32)
@@ -97,12 +120,17 @@ def main():
     weights_j = jnp.asarray(edge_weight)
     for epoch in range(args.epochs):
         rng.shuffle(train_idx)
+        rows = w_rows = None
+        if windowed:
+            rows, w_rows = shuffled_views(weights_j,
+                                          jax.random.key(555 + epoch))
         t0, tot, nb = time.time(), 0.0, 0
         for lo in range(0, min(len(train_idx), 40 * bs) - bs + 1, bs):
             seeds = jnp.asarray(train_idx[lo:lo + bs], jnp.int32)
             y = jnp.asarray(labels[train_idx[lo:lo + bs]])
             state, loss = step(state, weights_j, seeds, y,
-                               jax.random.key(epoch * 10000 + nb))
+                               jax.random.key(epoch * 10000 + nb),
+                               rows, w_rows)
             tot += float(loss)
             nb += 1
         # refresh sampling weights from degree-normalized attention proxy:

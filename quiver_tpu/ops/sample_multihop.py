@@ -82,6 +82,16 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
             "weight_rows is only consumed by windowed WEIGHTED sampling "
             "— pass edge_weight (the trigger) and a rotation/window "
             "method with it, or drop it")
+    if (edge_weight is not None and windowed and indices_rows is not None
+            and weight_rows is None):
+        # silently running the exact pool draw here would ignore the
+        # supplied rows AND pair (possibly permuted) neighbor ids with
+        # unpermuted weights
+        raise ValueError(
+            "weighted windowed sampling needs weight_rows co-shuffled "
+            "with indices_rows (reshuffle_csr(..., extra=(edge_weight,)) "
+            "then as_index_rows* both); drop indices_rows for the exact "
+            "pool draw")
     if edge_weight is None and windowed and indices_rows is None:
         # the no-arg fallback must not sample consecutive runs of the
         # caller's (possibly raw CSR) order — that permanently

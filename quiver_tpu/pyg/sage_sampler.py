@@ -210,6 +210,24 @@ class GraphSageSampler:
                       jax.device_put(self.csr_topo.indices, dev))
         self._placed = placed
 
+    def _ensure_weights_placed(self):
+        """Materialize the edge-weight array once — pinned host in HOST
+        mode (E-sized arrays don't fit HBM there; same placement as the
+        indices). The single entry point for sample() AND reshuffle(),
+        whichever runs first."""
+        if self._weight_placed is not None:
+            return
+        self._weight_placed = jnp.asarray(self.edge_weight)
+        if self.mode == "HOST":
+            try:
+                sh = jax.sharding.SingleDeviceSharding(
+                    list(self._weight_placed.devices())[0],
+                    memory_kind="pinned_host")
+                self._weight_placed = jax.device_put(
+                    self._weight_placed, sh)
+            except (ValueError, NotImplementedError):
+                pass
+
     def reshuffle(self, key=None):
         """Re-shuffle every CSR row's neighbor order (rotation sampling's
         freshness source). Called automatically on first sample; call at
@@ -232,19 +250,8 @@ class GraphSageSampler:
         base = self.csr_topo.eid if self.with_eid else None
         weighted = self.edge_weight is not None
         bfly = self.shuffle == "butterfly"
-        if weighted and self._weight_placed is None:
-            self._weight_placed = jnp.asarray(self.edge_weight)
-            if self.mode == "HOST":
-                # HOST mode = E-sized arrays don't fit HBM; the weight
-                # array is as big as indices and gets the same placement
-                try:
-                    sh = jax.sharding.SingleDeviceSharding(
-                        list(self._weight_placed.devices())[0],
-                        memory_kind="pinned_host")
-                    self._weight_placed = jax.device_put(
-                        self._weight_placed, sh)
-                except (ValueError, NotImplementedError):
-                    pass
+        if weighted:
+            self._ensure_weights_placed()
         if bfly:
             # composed state: feed the previous epoch's outputs back in
             src = self._permuted if self._permuted is not None else indices
@@ -359,8 +366,8 @@ class GraphSageSampler:
         if self.mode == "CPU":
             return self._sample_cpu(seeds, bs)
         fn = self._fn_for(bs)
-        if self.edge_weight is not None and self._weight_placed is None:
-            self._weight_placed = jnp.asarray(self.edge_weight)
+        if self.edge_weight is not None:
+            self._ensure_weights_placed()
         if self.sampling in ("rotation", "window"):
             if self._rot is None:
                 self.reshuffle()
