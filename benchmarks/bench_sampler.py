@@ -26,9 +26,12 @@ def main():
     p.add_argument("--pallas", action="store_true",
                    help="use the Pallas sampling kernel (single hop, "
                         "sizes[0]) — compare against --hop1 variants")
-    p.add_argument("--hop1", default=None, choices=["exact", "rotation"],
+    p.add_argument("--hop1", default=None,
+                   choices=["exact", "rotation", "wexact", "wwindow"],
                    help="single-hop jnp sampler at sizes[0] — the "
-                        "apples-to-apples baseline for --pallas")
+                        "apples-to-apples baseline for --pallas; "
+                        "wexact/wwindow = the weighted (GAT) draw, "
+                        "exact pool vs windowed")
     p.add_argument("--row-cap", type=int, default=2048)
     args = p.parse_args()
 
@@ -37,7 +40,10 @@ def main():
     import jax.numpy as jnp
     from quiver_tpu.ops import (as_index_rows_overlapping, edge_row_ids,
                                 permute_csr, sample_layer,
-                                sample_layer_rotation, sample_multihop)
+                                sample_layer_rotation,
+                                sample_layer_weighted,
+                                sample_layer_weighted_window,
+                                sample_multihop)
     from quiver_tpu.ops.pallas.sample_kernel import (
         pad_indices, sample_layer_pallas)
 
@@ -69,6 +75,11 @@ def main():
     # the graph arrays are jit ARGUMENTS everywhere below: a closed-over
     # device array is embedded in the HLO as a literal constant, and a
     # few-hundred-MB constant hangs the remote-compile tunnel
+    if args.hop1 in ("wexact", "wwindow"):
+        # ONE weights build for both weighted arms — the comparison
+        # stays apples-to-apples if the distribution is ever tweaked
+        wts = jax.jit(lambda k: jax.random.uniform(k, (e,)) + 0.1)(
+            jax.random.fold_in(key, 8))
     if args.pallas:
         big = pad_indices(indices, args.row_cap)
 
@@ -86,6 +97,30 @@ def main():
         def run(indptr, big, seeds, k):
             nbrs, counts = sample_layer(indptr, big, seeds,
                                         args.sizes[0], k)
+            return nbrs, jnp.sum(counts)
+    elif args.hop1 == "wexact":
+        big = (indices, wts)
+
+        @jax.jit
+        def run(indptr, big, seeds, k):
+            nbrs, counts = sample_layer_weighted(
+                indptr, big[0], big[1], seeds, args.sizes[0], k)
+            return nbrs, jnp.sum(counts)
+    elif args.hop1 == "wwindow":
+        rids = jax.jit(edge_row_ids, static_argnums=1)(indptr, e)
+        perm, (wperm,) = jax.jit(
+            lambda ix, w, r, kk: permute_csr(ix, r, kk, extra=(w,))
+        )(indices, wts, rids, jax.random.fold_in(key, 9))
+        big = (jax.block_until_ready(jax.jit(as_index_rows_overlapping)(
+                   perm)),
+               jax.block_until_ready(jax.jit(as_index_rows_overlapping)(
+                   wperm)))
+
+        @jax.jit
+        def run(indptr, big, seeds, k):
+            nbrs, counts = sample_layer_weighted_window(
+                indptr, big[0], big[1], seeds, args.sizes[0], k,
+                stride=128)
             return nbrs, jnp.sum(counts)
     elif args.hop1 == "rotation":
         rids = jax.jit(edge_row_ids, static_argnums=1)(indptr, e)

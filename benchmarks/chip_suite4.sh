@@ -21,6 +21,9 @@ step python -u benchmarks/debug_dispatch.py
 step python -u benchmarks/bench_sampler.py --pallas
 step python -u benchmarks/bench_sampler.py --hop1 exact
 step python -u benchmarks/bench_sampler.py --hop1 rotation
+# weighted (GAT) draw: exact pool vs the windowed draw
+step python -u benchmarks/bench_sampler.py --hop1 wexact
+step python -u benchmarks/bench_sampler.py --hop1 wwindow
 
 # 4. pallas gather (128-aligned + padded fallback) vs xla take
 step python -u benchmarks/bench_feature.py --pallas --dim 128
