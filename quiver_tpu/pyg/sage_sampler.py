@@ -475,12 +475,29 @@ class MixedGraphSageSampler:
 
     def __init__(self, sample_job: SampleJob, sizes: Sequence[int],
                  csr_topo: CSRTopo, device=None,
-                 device_mode: str = "HBM", num_workers: int = 2, seed: int = 0):
+                 device_mode: str = "HBM", num_workers: int = 2,
+                 seed: int = 0, **device_sampler_kwargs):
         self.job = sample_job
         self.sizes = list(sizes)
         self.num_workers = max(1, num_workers)
+        # device_sampler_kwargs pass through to the DEVICE side only
+        # (sampling="rotation", layout=, shuffle=); the host side always
+        # runs the native exact engine. Semantics-CHANGING kwargs are
+        # rejected: batches interleave nondeterministically between the
+        # two engines, so with_eid (host emits e_id=None) or edge_weight
+        # (host draws uniformly) would yield an inconsistent stream that
+        # fails or skews only when a host batch happens to be scheduled.
+        for bad in ("with_eid", "edge_weight"):
+            if device_sampler_kwargs.get(bad) not in (None, False):
+                raise ValueError(
+                    f"{bad} is not supported by the mixed sampler: the "
+                    "host engine cannot match it, and which batches come "
+                    "from the host is timing-dependent — use a pure "
+                    "device GraphSageSampler for that workload")
+        self._device_kwargs = dict(device_sampler_kwargs)
         self.device_sampler = GraphSageSampler(
-            csr_topo, sizes, device=device, mode=device_mode, seed=seed)
+            csr_topo, sizes, device=device, mode=device_mode, seed=seed,
+            **device_sampler_kwargs)
         self.cpu_sampler = GraphSageSampler(
             csr_topo, sizes, mode="CPU", seed=seed + 1)
         self._pool = None
@@ -511,6 +528,13 @@ class MixedGraphSageSampler:
 
     def __iter__(self):
         self.job.shuffle()
+        if getattr(self.device_sampler, "sampling", "exact") in (
+                "rotation", "window") and \
+                getattr(self.device_sampler, "_rot", None) is not None:
+            # epoch boundary: the mixed layer knows it (it just
+            # reshuffled the job), so it owns the rotation refresh too
+            # rather than pushing sampler internals onto callers
+            self.device_sampler.reshuffle()
         self._ensure_pool()
         import concurrent.futures as cf
         n = len(self.job)
@@ -583,10 +607,12 @@ class MixedGraphSageSampler:
     def share_ipc(self):
         return (self.job, self.sizes, self.device_sampler.csr_topo,
                 self.device_sampler.device, self.device_sampler.mode,
-                self.num_workers)
+                self.num_workers, self._device_kwargs)
 
     @classmethod
     def lazy_from_ipc_handle(cls, handle):
-        job, sizes, csr_topo, device, mode, workers = handle
+        # older 6-tuple handles (no device kwargs) still load
+        job, sizes, csr_topo, device, mode, workers = handle[:6]
+        kwargs = handle[6] if len(handle) > 6 else {}
         return cls(job, sizes, csr_topo, device=device,
-                   device_mode=mode, num_workers=workers)
+                   device_mode=mode, num_workers=workers, **kwargs)

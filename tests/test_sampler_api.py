@@ -302,6 +302,31 @@ class TestMixedSampler:
             assert bs == 16
             assert len(adjs) == 2
 
+    def test_device_side_options_pass_through(self, topo):
+        # rotation/overlap/butterfly on the device side, native exact on
+        # the host side — the kwargs must not leak into the CPU sampler
+        job = _ArrayJob(np.arange(topo.node_count)[:64], 16)
+        mixed = qv.MixedGraphSageSampler(
+            job, [3, 2], topo, num_workers=1, sampling="rotation",
+            layout="overlap", shuffle="butterfly")
+        assert mixed.device_sampler.sampling == "rotation"
+        assert mixed.cpu_sampler.sampling == "exact"
+        results = list(iter(mixed))
+        assert len(results) == 4
+        # second epoch auto-refreshes the rotation shuffle (the mixed
+        # layer owns the epoch boundary)
+        rot_before = mixed.device_sampler._rot
+        assert len(list(iter(mixed))) == 4
+        assert mixed.device_sampler._rot is not rot_before
+        # options survive the IPC handle roundtrip
+        rebuilt = qv.MixedGraphSageSampler.lazy_from_ipc_handle(
+            mixed.share_ipc())
+        assert rebuilt.device_sampler.sampling == "rotation"
+        assert rebuilt.device_sampler.shuffle == "butterfly"
+        # semantics-changing kwargs are rejected
+        with pytest.raises(ValueError, match="mixed"):
+            qv.MixedGraphSageSampler(job, [3, 2], topo, with_eid=True)
+
     def test_adapts_quota_to_skewed_speeds(self, topo):
         # skew the measured per-task times and assert the host quota
         # shifts the right way: slow host -> fewer host tasks, fast
