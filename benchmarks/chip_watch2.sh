@@ -1,0 +1,23 @@
+#!/bin/sh
+# Poll for the TPU backend to return from the outage, then immediately
+# run the round-3 rerun sweep (chip_suite4.sh). Probes are cheap
+# (init either succeeds in seconds or errors/hangs; 120s cap) and a
+# probe that never claims the device can't wedge it.
+cd "$(dirname "$0")/.."
+LOG=benchmarks/chip_watch.log
+echo "$(date) watcher2 start" >> "$LOG"
+i=0
+while [ $i -lt 200 ]; do
+    i=$((i + 1))
+    if timeout 120 python -c \
+        "import jax; d=jax.devices(); assert d[0].platform=='tpu'" \
+        >/dev/null 2>&1; then
+        echo "$(date) chip back (probe $i); running chip_suite4" >> "$LOG"
+        sh benchmarks/chip_suite4.sh >> "$LOG" 2>&1
+        echo "$(date) suite4 done" >> "$LOG"
+        exit 0
+    fi
+    echo "$(date) probe $i: still down" >> "$LOG"
+    sleep 120
+done
+echo "$(date) watcher2 gave up after $i probes" >> "$LOG"
