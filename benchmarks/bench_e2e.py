@@ -38,9 +38,6 @@ def main():
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 feature storage")
     args = p.parse_args()
-    if args.method == "window" and args.shuffle == "butterfly":
-        sys.exit("window+butterfly is statistically unsound for hubs "
-                 "(see GraphSageSampler's rejection of the combo)")
 
     from _common import configure_jax
     jax = configure_jax()

@@ -68,9 +68,6 @@ def main():
     p.add_argument("--npz", default=None)
     args = p.parse_args()
 
-    if args.sampling == "window" and args.shuffle == "butterfly":
-        sys.exit("window+butterfly is statistically unsound for hubs "
-                 "(see GraphSageSampler's rejection of the combo)")
     if args.sampling == "exact" and (
             "--shuffle" in sys.argv or "--layout" in sys.argv):
         sys.exit("--shuffle/--layout only apply to rotation/window "

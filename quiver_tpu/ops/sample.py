@@ -422,21 +422,20 @@ def sample_layer_window(indptr: jax.Array, indices_rows: jax.Array,
                         seeds: jax.Array, k: int, key: jax.Array,
                         with_slots: bool = False,
                         stride: int | None = None):
-    """Window sampling: an EXACT i.i.d. ``min(deg, k)``-subset drawn
-    uniformly without replacement from the window of the (pre-shuffled)
-    neighbor row that starts at the seed's segment — up to ~2*width
-    entries (>= 129 with the default 128-wide layouts).
+    """Window sampling: an i.i.d. ``min(deg, k)``-subset drawn uniformly
+    without replacement from a >=129-entry window of the (pre-shuffled)
+    neighbor row.
 
-    Statistics: for ``deg <= window`` this is exactly the reference
-    reservoir kernel's draw (i.i.d. uniform subsets) under ANY fixed
-    row order. For hub nodes beyond the window, the draw is an i.i.d.
-    subset of the epoch's window subset; the k/deg marginal then holds
-    only in expectation over the ``permute_csr`` shuffle, so hub-heavy
-    graphs REQUIRE the per-epoch reshuffle (without it, hub neighbors
-    outside the fixed window are never sampled — stricter than
-    rotation, whose random offset walks the whole segment every draw).
-    Unlike rotation (consecutive runs), two draws of the same node
-    within one epoch are independent k-subsets of the window.
+    Statistics: for ``deg <= window`` the window IS the whole segment,
+    so this is exactly the reference reservoir kernel's draw (i.i.d.
+    uniform subsets) under ANY row order — no shuffle needed at all for
+    such rows. Hub rows (deg > step+1) anchor their window at a
+    rotation-style uniform random offset, so every draw walks the whole
+    segment (the neighbor marginal is uniform in expectation over the
+    per-epoch reshuffle, exactly rotation's guarantee) while the subset
+    WITHIN the window is still an independent uniform draw — strictly
+    more within-epoch mixing than rotation's consecutive runs at the
+    same fetch cost. Any mixing reshuffle (sort or butterfly) serves.
 
     Cost: the same one (overlap layout, ``stride=width``) or two (pair
     layout) row gathers per seed as rotation, plus an O(bs*k^2)
@@ -452,13 +451,26 @@ def sample_layer_window(indptr: jax.Array, indices_rows: jax.Array,
     start, deg = _segment_heads(indptr, seeds)
     counts = jnp.minimum(deg, k)
 
-    w, r0, off = _gather_window(indices_rows, start, step, stride)
-    # the window covers neighbor positions [0, cap) of this seed's
-    # segment, cap = min(deg, win - off) >= min(deg, step + 1);
-    # Fisher-Yates draws min(cap, k) distinct positions in [0, cap)
-    # uniformly — an exact i.i.d. k-subset of the window
-    cap = jnp.minimum(deg, win - off)                       # [bs]
-    picks = off[:, None] + _fisher_yates_rows(key, cap, k)  # [bs, k] window pos
+    # hub rows anchor the window at a random in-segment offset o with
+    # >= step+1 entries guaranteed after it; rows whose WHOLE segment
+    # fits the start-anchored window keep o=0 — their draw is then an
+    # exact uniform k-subset of every neighbor under any fixed order
+    # (that can reach up to ~2*step depending on the start alignment,
+    # not just step+1)
+    kanchor, kdraw = jax.random.split(key)
+    bs = seeds.shape[0]
+    span = jnp.maximum(deg - (step + 1), 0) + 1
+    o = jax.random.randint(kanchor, (bs,), 0, span, dtype=jnp.int32)
+    start_off = (start % step).astype(jnp.int32)
+    o = jnp.where(deg <= win - start_off, 0, o)
+    p0 = start + o.astype(start.dtype)
+    w, r0, off = _gather_window(indices_rows, p0, step, stride)
+    # the window covers neighbor positions [o, o + cap) of the segment,
+    # cap = min(deg - o, win - off) >= min(deg, step + 1); Fisher-Yates
+    # draws min(cap, k) distinct positions uniformly — an i.i.d.
+    # k-subset of the window
+    cap = jnp.minimum(deg - o, win - off)                   # [bs]
+    picks = off[:, None] + _fisher_yates_rows(kdraw, cap, k)  # [bs, k]
     nbrs = _extract_window_cols(w, picks, k)
     mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
     if with_slots:

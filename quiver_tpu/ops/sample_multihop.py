@@ -36,13 +36,11 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     per epoch — or endpoint neighbors are under-sampled; pass the
     shuffled array as ``indices`` and its ``as_index_rows`` view as
     ``indices_rows``), or ``"window"`` (same row fetches as rotation
-    but an EXACT i.i.d. k-subset of the seed's >=129-entry shuffled
-    window — independent subsets within an epoch, exact for
-    deg <= window; NOTE window mode's anchored window makes the
-    per-epoch reshuffle mandatory on hub-heavy graphs — a hub's
-    neighbors beyond the window are unreachable until the next
-    shuffle, whereas rotation's random offset walks the whole segment
-    every draw). If ``indices_rows`` is omitted in rotation/window
+    but an i.i.d. k-subset of a >=129-entry window — independent
+    subsets within an epoch, exact for deg <= window under any row
+    order; hub rows anchor the window at a rotation-style random
+    offset, so any mixing reshuffle serves, butterfly included). If
+    ``indices_rows`` is omitted in rotation/window
     mode, one ``permute_csr`` is applied internally so the draw is
     still marginally uniform — correct but slower per call; callers on
     the hot path should shuffle per epoch themselves.

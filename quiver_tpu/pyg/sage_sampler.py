@@ -148,21 +148,21 @@ class GraphSageSampler:
             raise ValueError(f"unknown rotation layout {layout!r}")
         if shuffle not in ("sort", "butterfly"):
             raise ValueError(f"unknown shuffle {shuffle!r}")
-        if shuffle == "butterfly" and (
-                sampling == "window" or
-                (edge_weight is not None and sampling == "rotation")):
-            # window anchors its ~256-entry window at the segment start
-            # and relies on the reshuffle to re-place hub neighbors
-            # uniformly; butterfly moves an element <= 255 positions per
-            # epoch, so a hub's far neighbors would stay unreachable for
-            # many epochs — silent sampling bias. Rotation is safe (its
-            # random offset walks the whole segment every draw).
+        if shuffle == "butterfly" and edge_weight is not None and \
+                sampling in ("rotation", "window"):
+            # the WEIGHTED windowed draw anchors its window at the
+            # segment start and relies on the reshuffle to re-place hub
+            # neighbors uniformly; butterfly moves an element <= 255
+            # positions per epoch, so a hub's far neighbors would stay
+            # unreachable for many epochs — silent sampling bias.
+            # (Unweighted rotation AND window are safe: both walk the
+            # whole segment with a random per-draw anchor.)
             raise ValueError(
-                "shuffle='butterfly' cannot provide the anchored-window "
-                "draws' mandatory hub re-placement (bounded per-epoch "
-                "displacement): window mode and weighted rotation/window "
-                "both anchor at the segment start; use shuffle='sort' "
-                "there (unweighted rotation works with butterfly)")
+                "shuffle='butterfly' cannot provide the weighted "
+                "windowed draw's mandatory hub re-placement (bounded "
+                "per-epoch displacement; it anchors at the segment "
+                "start) — use shuffle='sort' for weighted "
+                "rotation/window")
         self.layout = layout
         self.shuffle = shuffle
         self._key = jax.random.key(seed)

@@ -308,6 +308,61 @@ class TestRotationSampler:
             assert len(set(slots[i].tolist())) == 6
             np.testing.assert_array_equal(indices[slots[i]], nbrs[i])
 
+    def test_window_hub_random_anchor_reaches_whole_segment(self):
+        # the hub window anchors at a random per-draw offset, so even
+        # with a FIXED order the draws reach the whole segment (under
+        # the start-anchored design, positions past ~256 were
+        # unreachable until a reshuffle); the positional marginal is
+        # edge-ramped over a ~window scale — uniformity comes from the
+        # reshuffle (next test)
+        from quiver_tpu.ops import as_index_rows, sample_layer_window
+        deg = 600
+        indptr = np.array([0, deg])
+        indices = np.arange(deg, dtype=np.int32)
+        rows = as_index_rows(jnp.asarray(indices))
+        counts = np.zeros(deg, np.int64)
+        for t in range(80):
+            nbrs, _ = sample_layer_window(
+                jnp.asarray(indptr), rows, jnp.zeros((16,), jnp.int32),
+                8, jax.random.key(t))
+            got = np.asarray(nbrs).ravel()
+            np.add.at(counts, got[got >= 0], 1)
+        # the deep interior (past the edge ramp) is hit and near-uniform
+        inner = counts[260:340]
+        assert (inner > 0).all()
+        freq = inner / counts.sum()
+        np.testing.assert_allclose(freq, counts[300] / counts.sum(),
+                                   rtol=0.8)
+        # positions far beyond the first window are sampled at all —
+        # the start-anchored design gave these exactly zero mass
+        assert counts[400:].sum() > 0
+
+    def test_window_hub_butterfly_epochs_uniform_marginal(self):
+        # with the cheap butterfly reshuffle composed across epochs the
+        # hub neighbor marginal approaches uniform — the property that
+        # makes window+butterfly a legal combination
+        from quiver_tpu.ops import (as_index_rows, butterfly_shuffle,
+                                    edge_row_ids, sample_layer_window)
+        deg = 600
+        indptr = np.array([0, deg])
+        base = np.arange(deg, dtype=np.int32)
+        row_ids = edge_row_ids(jnp.asarray(indptr), deg)
+        counts = np.zeros(deg, np.int64)
+        cur = jnp.asarray(base)
+        for ep in range(150):
+            cur = butterfly_shuffle(cur, row_ids, jax.random.key(700 + ep))
+            if ep < 30:
+                continue   # let the composition mix away the identity
+                           # order's edge bias before counting
+            nbrs, _ = sample_layer_window(
+                jnp.asarray(indptr), as_index_rows(cur),
+                jnp.zeros((16,), jnp.int32), 8, jax.random.key(9000 + ep))
+            got = np.asarray(nbrs).ravel()
+            np.add.at(counts, got[got >= 0], 1)
+        assert (counts > 0).all()
+        freq = counts / counts.sum()
+        np.testing.assert_allclose(freq, 1 / deg, atol=0.9 / deg)
+
     def test_window_masked_and_zero_degree(self):
         from quiver_tpu.ops import as_index_rows, sample_layer_window
         indptr = np.array([0, 0, 2, 2])
