@@ -19,8 +19,13 @@ from their first ``row_cap`` neighbors (documented truncation; CSR
 neighbor order is arbitrary, and row_cap=2048 covers the >99.9th degree
 percentile of the target graphs).
 
-``indices`` must be padded with ``row_cap`` trailing entries
+``indices`` must be padded with ``row_cap + 128`` trailing entries
 (``pad_indices``) so fixed-size row DMAs never read out of bounds.
+
+Row DMA starts are aligned DOWN to 128 (Mosaic rejects HBM slices that
+are not lane-aligned — learned from the gather kernel's first on-chip
+compile) and the <=127-entry residual offset shifts the position
+compare instead.
 """
 
 from __future__ import annotations
@@ -33,12 +38,22 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 BLOCK = 128
+# lane alignment for HBM DMA starts; the staging window is
+# row_cap + ALIGN wide everywhere (pad, kernel, scratch) — keep in sync
+# via _win()
+ALIGN = 128
+
+
+def _win(row_cap: int) -> int:
+    return row_cap + ALIGN
 
 
 def pad_indices(indices: jax.Array, row_cap: int) -> jax.Array:
-    """Append row_cap sentinel entries so row DMAs can overread safely."""
+    """Append row_cap + 128 sentinel entries so the aligned-start row
+    DMAs (start rounded down to 128, window row_cap + 128 wide) can
+    overread safely."""
     return jnp.concatenate(
-        [indices, jnp.zeros((row_cap,), indices.dtype)])
+        [indices, jnp.zeros((_win(row_cap),), indices.dtype)])
 
 
 def _fy_positions(degs: jax.Array, k: int, row_cap: int):
@@ -74,39 +89,45 @@ def _fy_positions(degs: jax.Array, k: int, row_cap: int):
 
 
 def _make_kernel(k: int, row_cap: int):
-    def kernel(starts_smem, degs_ref, seed_ref, indices_hbm,
+    win = _win(row_cap)     # aligned start + residual offset coverage
+
+    def kernel(starts_smem, meta_ref, seed_ref, indices_hbm,
                out_ref, cnt_ref, rows_vmem, sems):
         blk = pl.program_id(0)
         pltpu.prng_seed(seed_ref[0] + blk)
 
-        # stage BLOCK neighbor rows HBM -> VMEM (row_cap each)
+        # stage BLOCK neighbor rows HBM -> VMEM; starts_smem carries the
+        # 128-ALIGNED starts (Mosaic requires lane-aligned HBM slices)
         def start_dma(i, _):
             s = starts_smem[i]
             pltpu.make_async_copy(
-                indices_hbm.at[pl.ds(s, row_cap)],
+                indices_hbm.at[pl.ds(s, win)],
                 rows_vmem.at[i], sems.at[i]).start()
             return 0
 
         jax.lax.fori_loop(0, BLOCK, start_dma, 0)
 
-        degs = degs_ref[0]                                # [BLOCK]
+        degs = meta_ref[0]                                # [BLOCK]
+        offs = meta_ref[1]                                # [BLOCK] < 128
         pos = _fy_positions(degs, k, row_cap)             # [BLOCK, k]
 
         def wait_dma(i, _):
             pltpu.make_async_copy(
-                indices_hbm.at[pl.ds(starts_smem[i], row_cap)],
+                indices_hbm.at[pl.ds(starts_smem[i], win)],
                 rows_vmem.at[i], sems.at[i]).wait()
             return 0
 
         jax.lax.fori_loop(0, BLOCK, wait_dma, 0)
 
-        rows = rows_vmem[:, :]                            # [BLOCK, row_cap]
+        rows = rows_vmem[:, :]                            # [BLOCK, win]
         r_iota = jax.lax.broadcasted_iota(
-            jnp.int32, (BLOCK, row_cap), 1)
+            jnp.int32, (BLOCK, win), 1)
         counts = jnp.minimum(degs, k).astype(jnp.int32)
+        shifted = pos + offs[:, None]                     # window coords
         for i in range(k):
             sel = jnp.sum(
-                jnp.where(r_iota == pos[:, i][:, None], rows, 0), axis=1)
+                jnp.where(r_iota == shifted[:, i][:, None], rows, 0),
+                axis=1)
             valid_i = i < counts
             out_ref[:, i] = jnp.where(valid_i, sel.astype(jnp.int32), -1)
         cnt_ref[0] = counts
@@ -137,15 +158,21 @@ def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
     starts = jnp.where(valid, indptr[safe], 0).astype(jnp.int32)
     degs = jnp.where(valid, (indptr[safe + 1] - indptr[safe]), 0) \
         .astype(jnp.int32)
+    aligned = (starts // ALIGN) * ALIGN      # lane-aligned DMA starts
+    offs = starts - aligned                  # residual < 128
 
     grid = padded_bs // BLOCK
+    # meta rows interleave per block: [degs; offs]
+    meta = jnp.stack([degs.reshape(grid, BLOCK),
+                      offs.reshape(grid, BLOCK)], axis=1) \
+        .reshape(grid * 2, BLOCK)
     out, cnt = pl.pallas_call(
         _make_kernel(k, row_cap),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((BLOCK,), lambda b: (b,),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+            pl.BlockSpec((2, BLOCK), lambda b: (b, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -161,13 +188,13 @@ def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
             jax.ShapeDtypeStruct((grid, BLOCK), jnp.int32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BLOCK, row_cap), indices_padded.dtype),
+            pltpu.VMEM((BLOCK, _win(row_cap)), indices_padded.dtype),
             pltpu.SemaphoreType.DMA((BLOCK,)),
         ],
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
-    )(starts,
-      degs.reshape(grid, BLOCK),
+    )(aligned,
+      meta,
       jnp.asarray(seed, jnp.int32).reshape(1),
       indices_padded)
     return out[:bs], cnt.reshape(-1)[:bs]
