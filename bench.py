@@ -190,9 +190,17 @@ def main():
     # measures a full epoch the way training runs it: one per-epoch row
     # re-shuffle (rotation sampling's freshness source) + `batches`
     # sample_multihop calls.
+    _epochs = {}
+
     def make_epoch(n_batches, method, layout, shuffle):
+        # cache per config: the winner's re-measurement must reuse the
+        # already-compiled program, not build a fresh jit closure
+        ck = (n_batches, method, layout, shuffle)
+        if ck in _epochs:
+            return _epochs[ck]
+
         @jax.jit
-        def run_epoch(indptr, indices, row_ids, key):
+        def run_epoch(indptr, indices, row_ids, key, rows=None):
             kperm, kseed, kbatch = jax.random.split(key, 3)
             stride = None
             if method in ("rotation", "window"):
@@ -203,6 +211,14 @@ def main():
                     stride = 128
                 else:
                     rows = as_index_rows(permuted)
+            elif method == "exact" and rows is not None:
+                # the wide-fetch exact path: ``rows`` is a layout view
+                # of the UN-shuffLED indices built OUTSIDE the timed
+                # epoch (training builds it once per run, so the epoch
+                # must not re-pay it; the rotation arms' in-epoch
+                # reshuffle is genuine per-epoch work)
+                permuted = indices
+                stride = 128 if layout == "overlap" else None
             else:
                 permuted, rows = indices, None
             # epoch batching the way training runs it: a fresh
@@ -226,15 +242,31 @@ def main():
             total, _ = jax.lax.scan(
                 body, jnp.int32(0), jnp.arange(n_batches, dtype=jnp.int32))
             return total
+
+        _epochs[ck] = run_epoch
         return run_epoch
+
+    exact_rows = {}
 
     def measure(n_batches, method, layout, salt, shuffle):
         run = make_epoch(n_batches, method, layout, shuffle)
+        extra = ()
+        if method == "exact":
+            # one-time layout view (amortized in real training); built
+            # outside the timed region
+            if layout not in exact_rows:
+                f = (as_index_rows_overlapping if layout == "overlap"
+                     else as_index_rows)
+                exact_rows[layout] = jax.block_until_ready(
+                    jax.jit(f)(indices))
+            extra = (exact_rows[layout],)
         jax.block_until_ready(run(indptr, indices, row_ids,
-                                  jax.random.fold_in(key, 100 + salt)))
+                                  jax.random.fold_in(key, 100 + salt),
+                                  *extra))
         t0 = time.perf_counter()
         total_edges = int(run(indptr, indices, row_ids,
-                              jax.random.fold_in(key, 200 + salt)))
+                              jax.random.fold_in(key, 200 + salt),
+                              *extra))
         return total_edges / (time.perf_counter() - t0)
 
     # metric of record: rotation mode, full epoch (accuracy parity with
@@ -253,7 +285,13 @@ def main():
         cands = [(lay, shuffle_env) for lay in layouts]
     by_cfg = {cfg: measure(batches, "rotation", cfg[0], salt, shuffle=cfg[1])
               for salt, cfg in enumerate(cands)}
-    (layout, shuffle), seps = max(by_cfg.items(), key=lambda kv: kv[1])
+    (layout, shuffle), _sel = max(by_cfg.items(), key=lambda kv: kv[1])
+    # re-measure ONLY the winning config and report that re-measurement
+    # as the headline: max-of-noisy-arms is biased upward (winner's
+    # curse); the fresh run is an unbiased estimate of the chosen
+    # config. Cheap — the winner is already compiled.
+    seps = (measure(batches, "rotation", layout, 50, shuffle=shuffle)
+            if len(by_cfg) > 1 else _sel)
     # secondary figures on a shorter epoch slice (clamped to the seeds
     # the node count can supply): exact i.i.d. mode, and window mode
     # (same row fetches as rotation, exact i.i.d. subsets of each

@@ -27,11 +27,14 @@ def main():
                    help="use the Pallas sampling kernel (single hop, "
                         "sizes[0]) — compare against --hop1 variants")
     p.add_argument("--hop1", default=None,
-                   choices=["exact", "rotation", "wexact", "wwindow"],
+                   choices=["exact", "wide", "rotation", "wexact",
+                            "wwindow"],
                    help="single-hop jnp sampler at sizes[0] — the "
                         "apples-to-apples baseline for --pallas; "
-                        "wexact/wwindow = the weighted (GAT) draw, "
-                        "exact pool vs windowed")
+                        "wide = the wide-fetch exact path "
+                        "(sample_layer_exact_wide, same i.i.d. draw as "
+                        "exact); wexact/wwindow = the weighted (GAT) "
+                        "draw, exact pool vs windowed")
     p.add_argument("--row-cap", type=int, default=2048)
     args = p.parse_args()
 
@@ -40,6 +43,7 @@ def main():
     import jax.numpy as jnp
     from quiver_tpu.ops import (as_index_rows_overlapping, edge_row_ids,
                                 permute_csr, sample_layer,
+                                sample_layer_exact_wide,
                                 sample_layer_rotation,
                                 sample_layer_weighted,
                                 sample_layer_weighted_window,
@@ -97,6 +101,18 @@ def main():
         def run(indptr, big, seeds, k):
             nbrs, counts = sample_layer(indptr, big, seeds,
                                         args.sizes[0], k)
+            return nbrs, jnp.sum(counts)
+    elif args.hop1 == "wide":
+        # flat + overlapping layout view of the SAME un-shuffled array
+        big = (indices,
+               jax.block_until_ready(
+                   jax.jit(as_index_rows_overlapping)(indices)))
+
+        @jax.jit
+        def run(indptr, big, seeds, k):
+            nbrs, counts = sample_layer_exact_wide(
+                indptr, big[0], big[1], seeds, args.sizes[0], k,
+                stride=128)
             return nbrs, jnp.sum(counts)
     elif args.hop1 == "wexact":
         big = (indices, wts)

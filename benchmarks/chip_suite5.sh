@@ -1,0 +1,29 @@
+#!/bin/sh
+# Round-4 additions to the on-chip sweep (run AFTER chip_suite4.sh):
+# the wide-fetch exact path, the mixed sampler's adaptivity, and the
+# refreshed bench.py (now: winner re-measured headline + exact arm
+# through the wide path). Appends to benchmarks/chip_suite.log.
+# NEVER kill a step mid-claim; the per-step timeout is the only reaper.
+cd "$(dirname "$0")/.."
+LOG=benchmarks/chip_suite.log
+. benchmarks/_suite_common.sh
+
+date | tee -a "$LOG"
+
+# 1. exact-mode head-to-head: scattered vs wide-fetch (same i.i.d. draw)
+step python -u benchmarks/bench_sampler.py --hop1 exact
+step python -u benchmarks/bench_sampler.py --hop1 wide
+step python -u benchmarks/bench_sampler.py --hop1 rotation
+
+# 2. full-epoch exact through bench.py (exact_mode_value now = wide path)
+step python -u bench.py
+
+# 3. e2e epoch seconds with the wide exact path
+step python -u benchmarks/bench_e2e.py --method exact
+
+# 4. mixed sampler adaptivity: device-only vs mixed + converged split
+step python -u benchmarks/bench_mixed.py --sampling rotation
+step python -u benchmarks/bench_mixed.py --sampling exact
+
+date | tee -a "$LOG"
+echo "chip suite 5 (round-4 additions) complete -> $LOG"

@@ -65,14 +65,22 @@ def main():
                         "cheaper masked swap network)")
     p.add_argument("--data-parallel", action="store_true",
                    help="shard the batch over all local devices")
-    p.add_argument("--npz", default=None)
+    p.add_argument("--npz", "--data-dir", dest="npz", default=None,
+                   help="real dataset: an .npz bundle or a directory of "
+                        ".npy files (keys edge_index, feat, labels, "
+                        "train_idx[, valid_idx, test_idx] — the standard "
+                        "OGB dump, see quiver_tpu.datasets)")
     args = p.parse_args()
 
-    if args.sampling == "exact" and (
-            "--shuffle" in sys.argv or "--layout" in sys.argv):
-        sys.exit("--shuffle/--layout only apply to rotation/window "
-                 "sampling; add --sampling rotation (or window) or drop "
-                 "the flag — exact mode would silently ignore it")
+    # compare parsed values to the parser defaults (argparse-accepted
+    # forms like --shuffle=butterfly or abbreviations would bypass a
+    # literal sys.argv scan); --layout is meaningful in every mode now
+    # (exact uses it for the wide-fetch rows view), --shuffle is not
+    if args.sampling == "exact" and args.shuffle != p.get_default("shuffle"):
+        sys.exit("--shuffle only applies to rotation/window sampling "
+                 "(exact needs no reshuffle); add --sampling rotation "
+                 "(or window) or drop the flag — exact mode would "
+                 "silently ignore it")
 
     import jax
     import jax.numpy as jnp
@@ -88,12 +96,16 @@ def main():
         init_state, layers_to_adjs, masked_feature_gather)
 
     if args.npz:
-        data = np.load(args.npz)
-        topo = qv.CSRTopo(edge_index=data["edge_index"])
-        feat_np, labels, train_idx = (data["feat"], data["labels"],
-                                      data["train_idx"])
+        # the dataset adapter accepts an .npz bundle or a directory of
+        # .npy files (see quiver_tpu/datasets.py for the OGB export
+        # one-liner that produces either)
+        ds = qv.from_numpy_dir(args.npz)
+        topo = ds.csr_topo
+        feat_np, labels, train_idx = ds.feat, ds.labels, ds.train_idx
         indptr = np.asarray(topo.indptr)
         indices = np.asarray(topo.indices)
+        if args.classes < ds.num_classes:
+            args.classes = ds.num_classes
     else:
         indptr, indices, feat_np, labels, train_idx = synthetic(
             args.nodes, args.avg_deg, args.dim, args.classes)
@@ -140,11 +152,15 @@ def main():
     # rotation/window state: per-epoch refreshed rows view (+ the
     # butterfly's composed permuted state)
     windowed = args.sampling in ("rotation", "window")
-    stride = 128 if (windowed and args.layout == "overlap") else None
+    stride = 128 if args.layout == "overlap" else None
     as_rows = (as_index_rows_overlapping if stride else as_index_rows)
     row_ids = (jax.jit(edge_row_ids, static_argnums=1)(
         indptr_j, int(indices_j.shape[0])) if windowed else None)
     permuted_j = indices_j
+    # exact mode: a static layout view of the UN-shuffled indices routes
+    # the draw through the wide-fetch exact path (same i.i.d. draw,
+    # fewer scattered loads); no per-epoch refresh needed
+    exact_rows = None if windowed else as_rows(indices_j)
 
     def refresh_rows(epoch):
         nonlocal permuted_j
@@ -176,7 +192,7 @@ def main():
     it = 0
     for epoch in range(args.epochs):
         perm = rng.permutation(train_idx)
-        rows = refresh_rows(epoch) if windowed else None
+        rows = refresh_rows(epoch) if windowed else exact_rows
         t0 = time.perf_counter()
         epoch_loss, nb = 0.0, 0
         starts = list(range(0, len(perm) - bs + 1, bs))
@@ -184,8 +200,8 @@ def main():
             for lo in starts:
                 seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
                 y = jnp.asarray(labels[perm[lo:lo + bs]])
-                # rows is None in exact mode (permuted_j == indices_j);
-                # every step builder accepts the trailing None
+                # exact mode: rows is the static un-shuffled view
+                # (wide-fetch exact path; permuted_j == indices_j)
                 state, loss = step(state, feat_j, forder, indptr_j,
                                    permuted_j, seeds, y,
                                    jax.random.key(it), rows)

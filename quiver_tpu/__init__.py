@@ -36,7 +36,8 @@ from .hetero import HeteroCSRTopo, HeteroGraphSageSampler
 from .async_sampler import AsyncNeighborSampler, AsyncCudaNeighborSampler
 from .debug import show_tensor_info
 from .inference import layerwise_inference
-from . import comm, profiling, checkpoint, debug
+from .datasets import GraphDataset, from_numpy_dir
+from . import comm, profiling, checkpoint, datasets, debug
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -44,6 +45,8 @@ NcclComm = TpuComm
 getNcclId = get_comm_id
 
 __all__ = [
+    "GraphDataset",
+    "from_numpy_dir",
     "CSRTopo",
     "parse_size",
     "reindex_by_config",

@@ -1,0 +1,150 @@
+"""Real-dataset adapter: load a user's OGB-style numpy dump into the
+framework's native structures.
+
+The environment this framework ships from has no dataset egress, so (like
+the repo's examples) tests run on synthetics — but a user with a real
+dataset (ogbn-products, Reddit, ...) should not have to hand-roll the
+glue the reference's examples get from ``PygNodePropPredDataset``
+(reference examples/pyg/reddit_quiver.py:1-60,
+examples/multi_gpu/pyg/ogb-products/dist_sampling_ogb_products_quiver.py).
+One ``numpy`` export on any machine with the data:
+
+    import numpy as np
+    from ogb.nodeproppred import PygNodePropPredDataset
+    ds = PygNodePropPredDataset("ogbn-products", root=...)
+    data, split = ds[0], ds.get_idx_split()
+    np.savez("products.npz",
+             edge_index=data.edge_index.numpy(),
+             feat=data.x.numpy(),
+             labels=data.y.numpy().squeeze(),
+             train_idx=split["train"].numpy(),
+             valid_idx=split["valid"].numpy(),
+             test_idx=split["test"].numpy())
+
+then loads here as ``from_numpy_dir("products.npz")`` (a directory of
+per-key ``.npy`` files with the same names works too) and plugs straight
+into ``CSRTopo`` + ``Feature`` + the train loops
+(``examples/train_products_synthetic.py --data-dir``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from .utils import CSRTopo
+
+#: required keys and their expected rank
+_REQUIRED = {"edge_index": 2, "feat": 2, "labels": 1, "train_idx": 1}
+_OPTIONAL = {"valid_idx": 1, "test_idx": 1}
+
+
+class GraphDataset(NamedTuple):
+    """A loaded node-classification dataset, framework-native.
+
+    ``csr_topo`` is ready for any sampler; ``feat``/``labels`` are host
+    numpy (hand ``feat`` to ``quiver_tpu.Feature`` with whatever cache
+    policy fits the machine); ``*_idx`` are the official splits
+    (``valid_idx``/``test_idx`` may be None).
+    """
+
+    csr_topo: CSRTopo
+    feat: np.ndarray
+    labels: np.ndarray
+    train_idx: np.ndarray
+    valid_idx: Optional[np.ndarray]
+    test_idx: Optional[np.ndarray]
+
+    @property
+    def num_classes(self) -> int:
+        # papers100M-style dumps store float labels with NaN on
+        # unlabeled nodes; classes count over the labeled ones
+        finite = self.labels[np.isfinite(
+            self.labels.astype(np.float64, copy=False))]
+        if finite.size == 0:
+            raise ValueError("labels contain no finite entries")
+        return int(finite.max()) + 1
+
+
+def _load_mapping(path: str) -> dict:
+    """Accept either a ``.npz`` bundle or a directory of ``.npy`` files
+    named after the keys."""
+    if os.path.isfile(path):
+        return dict(np.load(path))
+    if os.path.isdir(path):
+        out = {}
+        for key in {**_REQUIRED, **_OPTIONAL}:
+            f = os.path.join(path, key + ".npy")
+            if os.path.exists(f):
+                out[key] = np.load(f)
+        return out
+    raise FileNotFoundError(
+        f"{path!r} is neither an .npz file nor a directory of .npy files")
+
+
+def from_numpy_dir(path: str, undirected: bool = False) -> GraphDataset:
+    """Load an OGB-style numpy dump (see module docstring for the
+    one-liner that produces it) into ``GraphDataset``.
+
+    Required keys: ``edge_index`` [2, E] int, ``feat`` [N, dim],
+    ``labels`` [N] (an [N, 1] column is squeezed), ``train_idx``.
+    Optional: ``valid_idx``, ``test_idx``. ``undirected=True`` adds the
+    reverse of every edge (OGB products/Reddit dumps are already
+    symmetric; set it for directed dumps when the model expects
+    symmetric message passing).
+    """
+    data = _load_mapping(path)
+    missing = [k for k in _REQUIRED if k not in data]
+    if missing:
+        raise KeyError(
+            f"dataset at {path!r} is missing key(s) {missing}; expected "
+            f"{sorted(_REQUIRED)} (+ optional {sorted(_OPTIONAL)})")
+
+    labels = np.asarray(data["labels"])
+    if labels.ndim == 2 and labels.shape[1] == 1:
+        labels = labels[:, 0]
+    feat = np.ascontiguousarray(data["feat"])
+    for key, rank in {**_REQUIRED, **_OPTIONAL}.items():
+        if key in data and key != "labels" and np.asarray(data[key]).ndim != rank:
+            raise ValueError(
+                f"{key} must be rank {rank}, got shape "
+                f"{np.asarray(data[key]).shape}")
+    if labels.ndim != 1:
+        raise ValueError(f"labels must be [N] or [N, 1], got {labels.shape}")
+
+    edge_index = np.asarray(data["edge_index"])
+    if edge_index.shape[0] != 2:
+        raise ValueError(
+            f"edge_index must be [2, E], got {edge_index.shape}")
+    n = feat.shape[0]
+    if labels.shape[0] != n:
+        raise ValueError(
+            f"feat has {n} rows but labels has {labels.shape[0]}")
+    if edge_index.size and int(edge_index.max()) >= n:
+        raise ValueError(
+            f"edge_index references node {int(edge_index.max())} but "
+            f"feat only has {n} rows")
+    if edge_index.size and int(edge_index.min()) < 0:
+        # a -1 sentinel would silently wrap to node n-1 in the CSR build
+        raise ValueError(
+            f"edge_index contains negative node id "
+            f"{int(edge_index.min())}")
+    if undirected:
+        edge_index = np.concatenate(
+            [edge_index, edge_index[::-1]], axis=1)
+
+    def _idx(key):
+        if key not in data:
+            return None
+        idx = np.asarray(data[key]).astype(np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= n):
+            raise ValueError(f"{key} out of range [0, {n})")
+        return idx
+
+    topo = CSRTopo(edge_index=edge_index, node_count=n)
+    return GraphDataset(csr_topo=topo, feat=feat, labels=labels,
+                        train_idx=_idx("train_idx"),
+                        valid_idx=_idx("valid_idx"),
+                        test_idx=_idx("test_idx"))

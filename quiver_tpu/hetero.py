@@ -24,7 +24,10 @@ from typing import Dict, List, NamedTuple, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from .ops.sample import compact_union, sample_layer
+from .ops.sample import (as_index_rows, as_index_rows_overlapping,
+                         compact_union, edge_row_ids, reshuffle_csr,
+                         sample_layer, sample_layer_exact_wide,
+                         sample_layer_rotation, sample_layer_window)
 from .pyg.sage_sampler import Adj
 from .utils import CSRTopo
 
@@ -76,30 +79,111 @@ class HeteroGraphSageSampler:
     ``sizes`` is a list of per-hop fanouts; each entry is either an int
     (same fanout for every relation) or a ``{edge_type: k}`` dict.
     ``sample(seeds)`` seeds are nodes of ``seed_type``.
+
+    Performance modes (the same engine as the homogeneous sampler, per
+    relation — the reference's MAG240M path only ever samples its
+    homogeneous projection, train_quiver_multi_node.py:90-93, so each
+    of these is beyond-parity):
+
+    - ``sampling="exact"`` (default): i.i.d. Fisher-Yates draws through
+      the wide-fetch path (``sample_layer_exact_wide``) — one/two row
+      gathers per low-degree seed per relation, scattered loads only
+      for hubs. No reshuffle needed.
+    - ``sampling="rotation"`` / ``"window"``: the wide row-fetch draws
+      over per-relation shuffled row views; call ``reshuffle()`` per
+      epoch (automatic on first sample). ``shuffle="butterfly"`` is the
+      ~40x cheaper composed epoch re-mix.
+    - ``layout="overlap"``: one 256-wide gather per seed instead of two
+      128-wide, at 2x index memory — per relation.
+
+    ``frontier_cap`` bounds each node type's frontier capacity (an int,
+    or ``{node_type: int}``): multi-relation expansion otherwise grows
+    frontier caps multiplicatively per hop. Sampled edges whose source
+    falls past the cap are masked (-1) — the same static-capacity
+    truncation contract as every other capped shape here.
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
-                 seed_type: str, seed: int = 0):
+                 seed_type: str, seed: int = 0, sampling: str = "exact",
+                 layout: str = "pair", shuffle: str = "sort",
+                 frontier_cap=None):
         self.topo = topo
         self.seed_type = seed_type
         self.sizes = [s if isinstance(s, dict)
                       else {et: s for et in topo.edge_types}
                       for s in sizes]
+        if sampling not in ("exact", "rotation", "window"):
+            raise ValueError(f"unknown sampling method {sampling!r}")
+        if layout not in ("pair", "overlap"):
+            raise ValueError(f"unknown layout {layout!r}")
+        if shuffle not in ("sort", "butterfly"):
+            raise ValueError(f"unknown shuffle {shuffle!r}")
+        max_k = max((k for hop in self.sizes for k in hop.values()),
+                    default=0)
+        if sampling in ("rotation", "window") and max_k > 128:
+            raise ValueError(f"{sampling} sampling supports fanouts <= 128")
+        self.sampling = sampling
+        self.layout = layout
+        self.shuffle = shuffle
+        if frontier_cap is not None and not isinstance(frontier_cap, dict):
+            frontier_cap = {t: int(frontier_cap) for t in topo.node_types}
+        self.frontier_cap = frontier_cap
         self._key = jax.random.key(seed)
         self._fn_cache = {}
+        self._rows = None        # {edge_type: rows view}
+        self._permuted = {}      # butterfly composition state
+        self._row_ids = {}
+        self._rels_placed = None  # {edge_type: (indptr, indices)}
 
     def next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _as_rows(self, flat):
+        return (as_index_rows_overlapping(flat)
+                if self.layout == "overlap" else as_index_rows(flat))
+
+    @property
+    def _stride(self):
+        return 128 if self.layout == "overlap" else None
+
+    def reshuffle(self, key=None):
+        """Per-epoch refresh of every relation's shuffled row view
+        (rotation/window freshness source; exact mode needs none)."""
+        if self.sampling not in ("rotation", "window"):
+            raise ValueError(
+                "reshuffle only applies to rotation/window sampling")
+        key = key if key is not None else self.next_key()
+        bfly = self.shuffle == "butterfly"
+        rows = {}
+        for i, (et, t) in enumerate(sorted(self.topo.rels.items())):
+            indices = jnp.asarray(t.indices)
+            rid = self._row_ids.get(et)
+            if rid is None:
+                rid = jax.jit(edge_row_ids, static_argnums=1)(
+                    jnp.asarray(t.indptr), int(indices.shape[0]))
+                self._row_ids[et] = rid
+            src = (self._permuted.get(et, indices) if bfly else indices)
+            permuted = reshuffle_csr(src, rid, jax.random.fold_in(key, i),
+                                     method=self.shuffle)
+            if bfly:
+                self._permuted[et] = permuted
+            rows[et] = self._as_rows(permuted)
+        self._rows = rows
+
     def _build(self, batch_size: int):
         sizes = self.sizes
-        rels = {et: (jnp.asarray(t.indptr), jnp.asarray(t.indices))
-                for et, t in self.topo.rels.items()}
         seed_type = self.seed_type
         node_types = self.topo.node_types
+        method = self.sampling
+        stride = self._stride
+        caps = self.frontier_cap
 
-        def run(seeds, key):
+        # rels/rows enter as jit ARGUMENTS (pytrees), never closures: a
+        # closed-over device array is embedded in the HLO as a literal
+        # constant, and MAG240M-scale relations would overflow a remote
+        # (tunnel) compile request — same hazard bench.py documents
+        def run(seeds, key, rows, rels):
             frontier = {t: None for t in node_types}
             frontier[seed_type] = seeds.astype(jnp.int32)
             hops = []
@@ -115,7 +199,18 @@ class HeteroGraphSageSampler:
                     sub = jax.random.fold_in(key, step)
                     step += 1
                     indptr, indices = rels[et]
-                    nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
+                    if method == "rotation":
+                        nbrs, _ = sample_layer_rotation(
+                            indptr, rows[et], cur, k, sub, stride=stride)
+                    elif method == "window":
+                        nbrs, _ = sample_layer_window(
+                            indptr, rows[et], cur, k, sub, stride=stride)
+                    elif rows is not None:
+                        nbrs, _ = sample_layer_exact_wide(
+                            indptr, indices, rows[et], cur, k, sub,
+                            stride=stride)
+                    else:
+                        nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
                     per_rel_samples[et] = (cur, nbrs)
                 # 2. per src type: compact (old frontier ++ all sampled)
                 new_frontier = dict(frontier)
@@ -131,6 +226,15 @@ class HeteroGraphSageSampler:
                     all_nbrs = jnp.concatenate(
                         [nbrs.reshape(-1) for _, _, nbrs in group])
                     n_id, n_count, extra_local = compact_union(prev, all_nbrs)
+                    cap = caps.get(src_t) if caps else None
+                    if cap is not None and n_id.shape[0] > cap:
+                        # static-capacity truncation: keep the seeds-
+                        # first prefix, mask edges whose source fell
+                        # past the cap (same -1 contract as everywhere)
+                        n_id = n_id[:cap]
+                        n_count = jnp.minimum(n_count, cap)
+                        extra_local = jnp.where(
+                            extra_local < cap, extra_local, -1)
                     # n_id holds prev ++ unique new, first-occurrence order
                     new_frontier[src_t] = n_id
                     new_counts[src_t] = n_count
@@ -158,11 +262,30 @@ class HeteroGraphSageSampler:
     def sample(self, seeds):
         seeds = jnp.asarray(seeds, jnp.int32)
         bs = int(seeds.shape[0])
+        if self.frontier_cap is not None and \
+                self.frontier_cap.get(self.seed_type, bs) < bs:
+            raise ValueError(
+                f"frontier_cap[{self.seed_type!r}] = "
+                f"{self.frontier_cap[self.seed_type]} < batch size {bs}: "
+                "the cap would truncate the seeds themselves")
+        if self._rows is None:
+            if self.sampling in ("rotation", "window"):
+                self.reshuffle()
+            else:
+                # exact: static layout views of the un-shuffled indices
+                # route every relation through the wide-fetch exact path
+                self._rows = {et: self._as_rows(jnp.asarray(t.indices))
+                              for et, t in self.topo.rels.items()}
+        if self._rels_placed is None:
+            self._rels_placed = {
+                et: (jnp.asarray(t.indptr), jnp.asarray(t.indices))
+                for et, t in self.topo.rels.items()}
         fn = self._fn_cache.get(bs)
         if fn is None:
             fn = self._build(bs)
             self._fn_cache[bs] = fn
-        frontier, hops = fn(seeds, self.next_key())
+        frontier, hops = fn(seeds, self.next_key(), self._rows,
+                            self._rels_placed)
         layers = [HeteroLayer(adjs=a, frontier=f, counts=c)
                   for a, f, c in hops]
         return frontier, bs, layers[::-1]

@@ -1,5 +1,6 @@
 from .sample import (
     sample_layer,
+    sample_layer_exact_wide,
     sample_layer_rotation,
     sample_layer_window,
     permute_csr,
@@ -23,6 +24,7 @@ from .weighted import (
 
 __all__ = [
     "sample_layer",
+    "sample_layer_exact_wide",
     "sample_layer_rotation",
     "sample_layer_window",
     "permute_csr",

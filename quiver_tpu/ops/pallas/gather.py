@@ -68,6 +68,15 @@ def gather_rows(feat: jax.Array, ids: jax.Array,
     b = ids.shape[0]
     out_dim = feat.shape[1]
     if out_dim % 128:
+        import warnings
+        # trace-time warning (once per shape under jit): the pad is a
+        # full-table HBM copy PER CALL — a hot-path cliff callers should
+        # avoid by storing the table 128-padded up front
+        warnings.warn(
+            f"gather_rows: feature dim {out_dim} is not a multiple of "
+            "128 — padding the whole table on every call (full-table "
+            "HBM copy). Store the table pre-padded to avoid this.",
+            stacklevel=2)
         feat = jnp.pad(feat, ((0, 0), (0, 128 - out_dim % 128)))
     dim = feat.shape[1]
     if b % _BLOCK_ROWS:

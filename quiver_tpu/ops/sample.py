@@ -480,6 +480,86 @@ def sample_layer_window(indptr: jax.Array, indices_rows: jax.Array,
     return jnp.where(mask, nbrs, -1), counts
 
 
+def sample_layer_exact_wide(indptr: jax.Array, indices: jax.Array,
+                            indices_rows: jax.Array, seeds: jax.Array,
+                            k: int, key: jax.Array,
+                            stride: int | None = None,
+                            hub_cap: int | None = None,
+                            with_slots: bool = False):
+    """Exact i.i.d. sampling at windowed-fetch cost.
+
+    Same draw as ``sample_layer`` — ``min(deg, k)`` distinct neighbors,
+    uniform without replacement, the reference reservoir kernel's
+    contract (cuda_random.cu.hpp:7-69) — but the per-seed memory traffic
+    is one (overlap layout) or two (pair) wide row gathers for every
+    seed whose whole segment fits its start-anchored window (deg <=
+    window - start%step; the vast majority on power-law graphs),
+    instead of k scattered loads. Only "hub" rows pay scattered loads,
+    and only up to a static budget ``hub_cap`` (default bs//2) of them;
+    if a batch exceeds the budget, a ``lax.cond`` falls back to the full
+    scattered gather for that batch — exactness holds in every case,
+    only the speedup degrades.
+
+    Unlike rotation/window, NO reshuffle is needed: the Fisher-Yates
+    positions are uniform under any fixed row order, so
+    ``indices_rows`` is just a layout view (``as_index_rows`` /
+    ``as_index_rows_overlapping``) of the SAME flat ``indices`` array
+    passed alongside (hub fallbacks read the flat array; both must be
+    in the same order).
+
+    Returns (neighbors [bs, k] -1 fill, counts [bs]); with
+    ``with_slots`` also each pick's flat CSR slot (-1 fill) — original-
+    order slots, directly usable for edge-id lookups.
+    """
+    step, win = _window_layout(indices_rows, stride, 1)  # k-cap-free
+    start, deg = _segment_heads(indptr, seeds)
+    counts = jnp.minimum(deg, k)
+    bs = seeds.shape[0]
+    e = indices.shape[0]
+    picks = _fisher_yates_rows(key, deg, k)              # exact, all rows
+
+    # wide path: every row whose segment fits the start-anchored window
+    off0 = (start % step).astype(jnp.int32)
+    low = deg <= (win - off0)
+    w, _, off = _gather_window(indices_rows, start, step, stride)
+    pos = off[:, None] + picks
+    nbrs = _extract_window_cols(
+        w, jnp.where(low[:, None], pos, 0), k)           # hubs: garbage
+
+    # hub path: scattered loads for at most hub_cap rows
+    if hub_cap is None:
+        hub_cap = max(1, bs // 2)
+    hub_cap = min(hub_cap, bs)
+    iota = jnp.arange(bs, dtype=jnp.int32)
+    hub = (~low) & (deg > 0)
+    n_hub = jnp.sum(hub).astype(jnp.int32)
+    hrank = jnp.cumsum(hub).astype(jnp.int32) - 1
+    okey = jnp.where(hub & (hrank < hub_cap), hrank, _I32_MAX)
+    _, hpos = jax.lax.sort((okey, iota), num_keys=1)
+    hpos = hpos[:hub_cap]              # hub row positions (garbage past n_hub)
+    h_valid = (jnp.arange(hub_cap, dtype=jnp.int32)
+               < jnp.minimum(n_hub, hub_cap))
+    h_start = start[hpos]
+    h_picks = picks[hpos]
+    g = jnp.clip(h_start[:, None] + h_picks.astype(h_start.dtype), 0, e - 1)
+    h_nbrs = indices[g].astype(jnp.int32)
+    tgt = jnp.where(h_valid, hpos, bs)                   # bs = drop slot
+    nbrs = nbrs.at[tgt].set(h_nbrs, mode="drop")
+
+    def _full_scatter(_):
+        ga = jnp.clip(start[:, None] + picks.astype(start.dtype), 0, e - 1)
+        return indices[ga].astype(jnp.int32)
+
+    nbrs = jax.lax.cond(n_hub > hub_cap, _full_scatter,
+                        lambda _: nbrs, None)
+    mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
+    nbrs = jnp.where(mask, nbrs, -1)
+    if with_slots:
+        slots = start[:, None] + picks.astype(start.dtype)
+        return nbrs, counts, jnp.where(mask, slots, -1)
+    return nbrs, counts
+
+
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
 

@@ -11,7 +11,8 @@ import jax.numpy as jnp
 
 from .sample import (LayerSample, as_index_rows, as_index_rows_overlapping,
                      compact_layer, edge_rows, permute_csr, sample_layer,
-                     sample_layer_rotation, sample_layer_window)
+                     sample_layer_exact_wide, sample_layer_rotation,
+                     sample_layer_window)
 from .weighted import sample_layer_weighted, sample_layer_weighted_window
 
 
@@ -29,8 +30,16 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
     sampling order (innermost target hop first).
 
-    ``method``: ``"exact"`` (default; i.i.d. Fisher-Yates subsets, k
-    scattered loads per seed), ``"rotation"`` (~3x faster on TPU: wide
+    ``method``: ``"exact"`` (default; i.i.d. Fisher-Yates subsets — k
+    scattered loads per seed, or, when ``indices_rows`` is ALSO passed
+    (a layout view of the same un-shuffled ``indices``), the wide-fetch
+    exact path ``sample_layer_exact_wide``: one/two row gathers for
+    every low-degree seed, scattered loads only for hub rows — same
+    draw, lower memory traffic. WARNING: the rows view MUST be built
+    from ``indices`` in its given order — a permuted view cannot be
+    detected here and would pair original-order edge slots with
+    permuted-order values, silently corrupting ``eid`` tracking),
+    ``"rotation"`` (~3x faster on TPU: wide
     row fetches per seed; draws consecutive runs of the row order, so
     rows must be shuffled with ``permute_csr`` — at least once, ideally
     per epoch — or endpoint neighbors are under-sampled; pass the
@@ -90,6 +99,16 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
             "with indices_rows (reshuffle_csr(..., extra=(edge_weight,)) "
             "then as_index_rows* both); drop indices_rows for the exact "
             "pool draw")
+    if edge_weight is not None and not windowed and indices_rows is not None:
+        # exact weighted runs the scattered pool draw; silently dropping
+        # a rows view the caller built (expecting the wide-fetch exact
+        # speedup to survive adding weights) is the same coupled-
+        # parameter trap the windowed guards above reject loudly
+        raise ValueError(
+            "indices_rows is not consumed by exact WEIGHTED sampling "
+            "(the pool draw is scattered) — drop indices_rows, or use a "
+            "rotation/window method with weight_rows for the windowed "
+            "weighted draw")
     if edge_weight is None and windowed and indices_rows is None:
         # the no-arg fallback must not sample consecutive runs of the
         # caller's (possibly raw CSR) order — that permanently
@@ -132,6 +151,13 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
             out = sample_layer_window(indptr, indices_rows, cur, k, sub,
                                       with_slots=track_eid,
                                       stride=indices_stride)
+        elif indices_rows is not None:
+            # exact + rows layout = the wide-fetch exact draw (same
+            # contract as sample_layer, fewer scattered loads); the
+            # rows view MUST be of the same un-shuffled ``indices``
+            out = sample_layer_exact_wide(
+                indptr, indices, indices_rows, cur, k, sub,
+                stride=indices_stride, with_slots=track_eid)
         else:
             out = sample_layer(indptr, indices, cur, k, sub,
                                with_slots=track_eid)

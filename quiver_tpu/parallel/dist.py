@@ -61,44 +61,53 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
     """
     sizes = list(sizes)
     h_count = mesh.shape[axis]
-    windowed = method in ("rotation", "window")
 
-    def per_shard(state: TrainState, feat, g2h, g2l, indptr, indices,
-                  seeds, labels, key, *extra):
-        rows = extra[0] if windowed else None
-        rep = extra[1:] if (windowed and with_replicate) else \
-            (extra if with_replicate else None)
-        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+    def make_per_shard(has_rows):
+        # shard_map arity is fixed at build time; ``has_rows`` says
+        # whether extra[0] is the rows view (mandatory for
+        # rotation/window, optional wide-path input for exact)
+        def per_shard(state: TrainState, feat, g2h, g2l, indptr, indices,
+                      seeds, labels, key, *extra):
+            rows = extra[0] if has_rows else None
+            rep = extra[1:] if (has_rows and with_replicate) else \
+                (extra if with_replicate else None)
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis))
 
-        def gather(feat_, n_id, _forder):
-            return dist_lookup_local(n_id, g2h, g2l, feat_, axis, h_count,
-                                     rows_per_host, dtype=feat_.dtype,
-                                     rep=rep or None)
+            def gather(feat_, n_id, _forder):
+                return dist_lookup_local(n_id, g2h, g2l, feat_, axis,
+                                         h_count, rows_per_host,
+                                         dtype=feat_.dtype,
+                                         rep=rep or None)
 
-        loss, grads = jax.value_and_grad(
-            lambda p: _fused_loss(model, loss_fn, sizes, per_host_batch,
-                                  p, feat, None, indptr, indices, seeds,
-                                  labels, key, method, rows,
-                                  indices_stride, gather=gather)
-        )(state.params)
-        return _pmean_update(state, tx, grads, loss, axis)
+            loss, grads = jax.value_and_grad(
+                lambda p: _fused_loss(model, loss_fn, sizes, per_host_batch,
+                                      p, feat, None, indptr, indices, seeds,
+                                      labels, key, method, rows,
+                                      indices_stride, gather=gather)
+            )(state.params)
+            return _pmean_update(state, tx, grads, loss, axis)
 
-    specs = [P(), P(axis), P(), P(), P(), P(), P(axis), P(axis), P()]
-    if windowed:
-        specs.append(P())            # indices_rows, replicated
-    if with_replicate:
-        specs += [P(), P(), P()]     # is_rep, rep_rank, bases
-    mapped = shard_map(
-        per_shard, mesh=mesh,
-        in_specs=tuple(specs),
-        out_specs=(P(), P()),
-        check_vma=False)
-    jitted = jax.jit(mapped)
+        return per_shard
+
+    def make_jitted(has_rows):
+        specs = [P(), P(axis), P(), P(), P(), P(), P(axis), P(axis), P()]
+        if has_rows:
+            specs.append(P())            # indices_rows, replicated
+        if with_replicate:
+            specs += [P(), P(), P()]     # is_rep, rep_rank, bases
+        return jax.jit(shard_map(
+            make_per_shard(has_rows), mesh=mesh,
+            in_specs=tuple(specs),
+            out_specs=(P(), P()),
+            check_vma=False))
+
+    jitted_by_rows = {True: make_jitted(True), False: make_jitted(False)}
 
     def step(state, feat, g2h, g2l, indptr, indices, seeds, labels, key,
              indices_rows=None, rep_args=()):
-        extra = (indices_rows,) if _check_rows(method, indices_rows,
-                                               "dist") else ()
+        _check_rows(method, indices_rows, "dist")
+        jitted = jitted_by_rows[indices_rows is not None]
+        extra = (indices_rows,) if indices_rows is not None else ()
         if with_replicate:
             if len(rep_args) != 3:
                 raise TypeError(

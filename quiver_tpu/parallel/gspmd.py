@@ -76,7 +76,6 @@ def build_gspmd_train_step(model, tx, sizes: Sequence[int], mesh: Mesh,
     XLA partitions the sampler over the batch shards and the matmuls
     over the model shards."""
     sizes = list(sizes)
-    windowed = method in ("rotation", "window")
     cache = {}
 
     def step(state: TrainState, feat, forder, indptr, indices, seeds,
@@ -98,18 +97,19 @@ def build_gspmd_train_step(model, tx, sizes: Sequence[int], mesh: Mesh,
     def sharded_step(state, feat, forder, indptr, indices, seeds, labels,
                      key, indices_rows=None):
         _check_rows(method, indices_rows, "gspmd")
-        fn = cache.get("fn")
+        has_rows = indices_rows is not None   # windowed always; exact may
+        fn = cache.get(has_rows)
         if fn is None:
             st_sh = state_sharding(state, mesh, model_axis)
             shardings = [st_sh, repl, repl, repl, repl, data, data, repl]
-            if windowed:
+            if has_rows:
                 shardings.append(repl)
             fn = jax.jit(
                 step,
                 in_shardings=tuple(shardings),
                 out_shardings=(st_sh, repl))
-            cache["fn"] = fn
-        extra = (indices_rows,) if windowed else ()
+            cache[has_rows] = fn
+        extra = (indices_rows,) if has_rows else ()
         return fn(state, feat, forder, indptr, indices, seeds, labels,
                   key, *extra)
 

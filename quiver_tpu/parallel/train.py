@@ -92,17 +92,17 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
 def _check_rows(method: str, indices_rows, kind: str) -> bool:
     """Shared indices_rows contract for the step builders: rotation and
     window REQUIRE the per-epoch shuffled view (as_index_rows /
-    as_index_rows_overlapping; refresh via permute_csr), exact forbids
-    it. Returns whether the method is windowed."""
+    as_index_rows_overlapping; refresh via permute_csr). exact
+    OPTIONALLY takes a layout view of the UN-shuffled indices — that
+    switches the scattered draw to the wide-fetch exact path
+    (``sample_layer_exact_wide``; same i.i.d. statistics, fewer
+    scattered loads). Returns whether the method is windowed."""
     windowed = method in ("rotation", "window")
     if windowed and indices_rows is None:
         raise TypeError(
             f"{method} {kind} step requires indices_rows (the shuffled "
             "as_index_rows/as_index_rows_overlapping view; refresh per "
             "epoch via permute_csr)")
-    if not windowed and indices_rows is not None:
-        raise TypeError(f"method={method!r} {kind} step takes no "
-                        "indices_rows")
     return windowed
 
 
@@ -172,23 +172,30 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
         return _pmean_update(state, tx, grads, loss, axis)
 
     specs = [P(), P(), P(), P(), P(), P(axis), P(axis), P()]
-    if method in ("rotation", "window"):
-        specs.append(P())   # indices_rows, replicated
-    mapped = shard_map(
+    # shard_map arity is fixed at build time, but exact may or may not
+    # bring the (optional) wide-path rows view — build both arities; jit
+    # compiles lazily so the unused one costs nothing
+    with_rows = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=tuple(specs + [P()]),   # indices_rows, replicated
+        out_specs=(P(), P()),
+        check_vma=False)
+    without_rows = shard_map(
         per_shard, mesh=mesh,
         in_specs=tuple(specs),
         out_specs=(P(), P()),
         check_vma=False)
-    jitted = jax.jit(mapped)
+    jitted_rows = jax.jit(with_rows)
+    jitted = jax.jit(without_rows)
 
-    # shard_map arity is fixed at build time from ``method``; validate the
-    # optional arg up front so a mismatch is a clear TypeError, not an
-    # opaque shard_map/jit arity failure
+    # validate the optional arg up front so a mismatch is a clear
+    # TypeError, not an opaque shard_map/jit arity failure
     def step(state, feat, forder, indptr, indices, seeds, labels, key,
              indices_rows=None):
-        if _check_rows(method, indices_rows, "e2e"):
-            return jitted(state, feat, forder, indptr, indices, seeds,
-                          labels, key, indices_rows)
+        _check_rows(method, indices_rows, "e2e")
+        if indices_rows is not None:
+            return jitted_rows(state, feat, forder, indptr, indices, seeds,
+                               labels, key, indices_rows)
         return jitted(state, feat, forder, indptr, indices, seeds, labels,
                       key)
 

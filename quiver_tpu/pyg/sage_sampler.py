@@ -26,10 +26,35 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..debug import log as _log
 from ..ops.sample import compact_layer, sample_layer, sample_prob
 from ..utils import CSRTopo
 
 T_co = TypeVar("T_co", covariant=True)
+
+
+def _pinned_put(arrays, dev, allow_fallback, what):
+    """Place ``arrays`` on the device's pinned host memory. Backends
+    without the ``pinned_host`` memory kind get a LOUD fallback: warn
+    via the package logger and return None (caller keeps its default
+    placement) when ``allow_fallback``, else raise — a silently
+    different performance regime is the failure mode the reference
+    guards with its CUDA check macros (quiver.cu.hpp:16-26)."""
+    try:
+        sh = jax.sharding.SingleDeviceSharding(
+            dev, memory_kind="pinned_host")
+        return [jax.device_put(a, sh) for a in arrays]
+    except (ValueError, NotImplementedError) as e:
+        if not allow_fallback:
+            raise ValueError(
+                "HOST mode: this backend has no 'pinned_host' memory "
+                f"kind (placing {what}): {e}. Default placement is a "
+                "different performance regime — construct the sampler "
+                "with allow_fallback=True to accept it") from e
+        _log("HOST mode: no 'pinned_host' memory kind on this backend; "
+             "%s falls back to default placement (a different "
+             "performance regime)", what)
+        return None
 
 
 @jax.tree_util.register_pytree_node_class
@@ -98,7 +123,8 @@ class GraphSageSampler:
                  device=None, mode: str = "HBM", seed: int = 0,
                  edge_weight=None, sampling: str = "exact",
                  with_eid: bool = False, layout: str = "pair",
-                 shuffle: str = "sort"):
+                 shuffle: str = "sort", allow_fallback: bool = True,
+                 wide_exact: bool = True):
         if mode not in ("HBM", "HOST", "CPU", "UVA", "GPU"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         # accept reference mode names: UVA -> HOST tier, GPU -> HBM
@@ -165,10 +191,20 @@ class GraphSageSampler:
                 "rotation/window")
         self.layout = layout
         self.shuffle = shuffle
+        # HOST-mode placement on backends without pinned_host memory:
+        # True = loud logged fallback to default placement, False = raise
+        self.allow_fallback = allow_fallback
+        # wide_exact: exact mode's wide-fetch path needs a layout view of
+        # the indices — +E (pair) or +2E (overlap) memory in the
+        # topology's tier. False keeps the zero-extra-copy scattered draw
+        # (same statistics, k scattered loads per seed) for graphs whose
+        # indices already fill most of HBM.
+        self.wide_exact = wide_exact
         self._key = jax.random.key(seed)
         self._placed = None
         self._weight_placed = None
         self._rot = None          # shuffled row view (pair or overlap)
+        self._exact_rows = None   # un-shuffled row view (wide exact path)
         self._rot_w = None        # co-shuffled weight row view
         self._rot_eid = None      # slot->edge-id map in permuted coords
         self._permuted = None     # flat permuted indices (butterfly state)
@@ -197,14 +233,12 @@ class GraphSageSampler:
         if self.mode == "HOST":
             # host-resident topology (UVA analogue): keep arrays in host
             # memory; XLA streams them to device per sample step
-            try:
-                s = jax.sharding.SingleDeviceSharding(
-                    dev, memory_kind="pinned_host")
-                placed = (jax.device_put(self.csr_topo.indptr, s),
-                          jax.device_put(self.csr_topo.indices, s))
-            except (ValueError, NotImplementedError):
-                placed = (np.asarray(self.csr_topo.indptr),
-                          np.asarray(self.csr_topo.indices))
+            got = _pinned_put(
+                [self.csr_topo.indptr, self.csr_topo.indices], dev,
+                self.allow_fallback, "the topology")
+            placed = (tuple(got) if got is not None else
+                      (np.asarray(self.csr_topo.indptr),
+                       np.asarray(self.csr_topo.indices)))
         else:
             placed = (jax.device_put(self.csr_topo.indptr, dev),
                       jax.device_put(self.csr_topo.indices, dev))
@@ -219,14 +253,29 @@ class GraphSageSampler:
             return
         self._weight_placed = jnp.asarray(self.edge_weight)
         if self.mode == "HOST":
-            try:
-                sh = jax.sharding.SingleDeviceSharding(
-                    list(self._weight_placed.devices())[0],
-                    memory_kind="pinned_host")
-                self._weight_placed = jax.device_put(
-                    self._weight_placed, sh)
-            except (ValueError, NotImplementedError):
-                pass
+            got = _pinned_put([self._weight_placed],
+                              list(self._weight_placed.devices())[0],
+                              self.allow_fallback, "the edge weights")
+            if got is not None:
+                self._weight_placed = got[0]
+
+    def _ensure_exact_rows(self):
+        """Layout view (pair/overlap per ``self.layout``) of the placed,
+        UN-shuffled indices — the wide-fetch exact path's input. Built
+        once; HOST mode keeps it host-resident like the flat array."""
+        if self._exact_rows is not None:
+            return self._exact_rows
+        from ..ops.sample import as_index_rows, as_index_rows_overlapping
+        as_rows = (as_index_rows_overlapping if self.layout == "overlap"
+                   else as_index_rows)
+        rows = as_rows(jnp.asarray(self._placed[1]))
+        if self.mode == "HOST":
+            got = _pinned_put([rows], list(rows.devices())[0],
+                              self.allow_fallback, "the exact rows view")
+            if got is not None:
+                rows = got[0]
+        self._exact_rows = rows
+        return rows
 
     def reshuffle(self, key=None):
         """Re-shuffle every CSR row's neighbor order (rotation sampling's
@@ -300,20 +349,16 @@ class GraphSageSampler:
             # then stream from host like the exact path's. The E-sized
             # edge-id map and the butterfly's persistent permuted state
             # get the same placement for the same reason.
-            try:
-                sh = jax.sharding.SingleDeviceSharding(
-                    list(rows.devices())[0], memory_kind="pinned_host")
-                rows = jax.device_put(rows, sh)
-                if self._rot_w is not None:
-                    self._rot_w = jax.device_put(self._rot_w, sh)
-                if self._rot_eid is not None:
-                    self._rot_eid = jax.device_put(self._rot_eid, sh)
-                if self._permuted is not None:
-                    self._permuted = jax.device_put(self._permuted, sh)
-                if self._permuted_w is not None:
-                    self._permuted_w = jax.device_put(self._permuted_w, sh)
-            except (ValueError, NotImplementedError):
-                pass
+            arrays = [rows, self._rot_w, self._rot_eid, self._permuted,
+                      self._permuted_w]
+            got = _pinned_put([a for a in arrays if a is not None],
+                              list(rows.devices())[0],
+                              self.allow_fallback, "the shuffled rows")
+            if got is not None:
+                it = iter(got)
+                (rows, self._rot_w, self._rot_eid, self._permuted,
+                 self._permuted_w) = [
+                    next(it) if a is not None else None for a in arrays]
         self._rot = rows
 
     # -- core ---------------------------------------------------------------
@@ -375,7 +420,14 @@ class GraphSageSampler:
             w_rows = self._rot_w
             eid_arr = self._rot_eid
         else:
-            rows = w_rows = None
+            # exact mode: the wide-fetch path wants a layout view of the
+            # SAME un-shuffled indices (no reshuffle needed — Fisher-
+            # Yates positions are uniform under any fixed order); the
+            # weighted pool draw has no use for it
+            rows = (self._ensure_exact_rows()
+                    if self.edge_weight is None and self.wide_exact
+                    else None)
+            w_rows = None
             eid_arr = (jnp.asarray(self.csr_topo.eid)
                        if self.with_eid and self.csr_topo.eid is not None
                        else None)
@@ -434,15 +486,23 @@ class GraphSageSampler:
     # -- process sharing (API compat; jax is single-process-per-host) -------
     def share_ipc(self):
         return (self.csr_topo, self.device, self.mode, self.sizes,
-                self.edge_weight, self.sampling, self.with_eid)
+                self.edge_weight, self.sampling, self.with_eid,
+                self.layout, self.shuffle)
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
+        # older 7-tuple handles (no layout/shuffle) still load and get
+        # the ctor defaults, like the Mixed sampler's handle[:6] pattern
         (csr_topo, device, mode, sizes, edge_weight, sampling,
-         with_eid) = ipc_handle
+         with_eid) = ipc_handle[:7]
+        extras = {}
+        if len(ipc_handle) > 7:
+            extras["layout"] = ipc_handle[7]
+        if len(ipc_handle) > 8:
+            extras["shuffle"] = ipc_handle[8]
         return cls(csr_topo, sizes, device=device, mode=mode,
                    edge_weight=edge_weight, sampling=sampling,
-                   with_eid=with_eid)
+                   with_eid=with_eid, **extras)
 
 
 class SampleJob(Generic[T_co]):

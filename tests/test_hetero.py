@@ -151,3 +151,116 @@ class TestMAG240MGNN:
         out = model.apply(params, x, adjs)
         assert out.shape == (adjs[-1].size[1], 5)
         assert bool(jnp.isfinite(out[:8]).all())
+
+
+class TestHeteroPerfModes:
+    """Rotation/window sampling + frontier cap on the hetero sampler
+    (r4: per-relation shuffled row views — beyond the reference's
+    homogeneous-projection MAG240M path)."""
+
+    @pytest.mark.parametrize("mode,layout,shuffle", [
+        ("rotation", "pair", "sort"),
+        ("rotation", "overlap", "butterfly"),
+        ("window", "overlap", "sort"),
+    ])
+    def test_membership_per_relation(self, mag_like, rng, mode, layout,
+                                     shuffle):
+        sampler = HeteroGraphSageSampler(
+            mag_like, sizes=[3, 2], seed_type="paper", sampling=mode,
+            layout=layout, shuffle=shuffle)
+        seeds = rng.choice(120, 8, replace=False)
+        frontier, _, layers = sampler.sample(seeds)
+        nsets = {et: [set(np.asarray(t.indices)[
+                          np.asarray(t.indptr)[v]:
+                          np.asarray(t.indptr)[v + 1]].tolist())
+                      for v in range(t.node_count)]
+                 for et, t in mag_like.rels.items()}
+        # walk hops in SAMPLING order (layers come outermost-first):
+        # each hop's edges connect the PRE-hop dst frontier (previous
+        # hop's output, seeds for hop 0) to the post-hop src frontier
+        pre = {"paper": np.asarray(seeds)}
+        checked = 0
+        for layer in layers[::-1]:
+            for et, adj in layer.adjs.items():
+                src_t, _, dst_t = et
+                src_front = np.asarray(layer.frontier[src_t])
+                dst_front = pre[dst_t]
+                ei = np.asarray(adj.edge_index)
+                for col, row in zip(ei[0], ei[1]):
+                    if col < 0:
+                        continue
+                    src_id = src_front[col]
+                    dst_id = dst_front[row]
+                    assert dst_id >= 0
+                    # the sampled edge must exist in that relation
+                    assert src_id in nsets[et][dst_id]
+                    checked += 1
+            pre = {t: np.asarray(f) for t, f in layer.frontier.items()
+                   if f is not None}
+        assert checked > 0
+
+    def test_rotation_marginal_uniform_across_reshuffles(self, rng):
+        # single relation, one dst node with 12 src neighbors, k=2:
+        # rotation + per-epoch reshuffle must hit each neighbor ~1/6
+        indptr = np.array([0, 12])
+        indices = np.arange(12)
+        topo = HeteroCSRTopo(
+            {("s", "r", "d"): qv.CSRTopo(indptr=indptr, indices=indices)},
+            {"s": 12, "d": 1})
+        sampler = HeteroGraphSageSampler(
+            topo, sizes=[2], seed_type="d", sampling="rotation")
+        hits = np.zeros(12)
+        for epoch in range(60):
+            sampler.reshuffle()
+            frontier, _, layers = sampler.sample(np.zeros(1, np.int64))
+            f = np.asarray(layers[0].frontier["s"])
+            for v in f[f >= 0]:
+                hits[v] += 1
+        freq = hits / hits.sum()
+        np.testing.assert_allclose(freq, 1 / 12, atol=0.035)
+
+    def test_frontier_cap_truncates_and_masks(self, mag_like, rng):
+        cap = 24
+        sampler = HeteroGraphSageSampler(
+            mag_like, sizes=[3, 2], seed_type="paper",
+            frontier_cap=cap)
+        seeds = rng.choice(120, 16, replace=False)
+        frontier, _, layers = sampler.sample(seeds)
+        for t, f in frontier.items():
+            if f is not None:
+                assert f.shape[0] <= cap
+        for layer in layers:
+            for t, c in layer.counts.items():
+                assert int(c) <= cap
+            for et, adj in layer.adjs.items():
+                ei = np.asarray(adj.edge_index)
+                # masked edges are -1; valid source ids stay in range
+                assert (ei[0][np.asarray(adj.mask)] < cap).all()
+        # seeds survive the cap (seeds-first prefix)
+        np.testing.assert_array_equal(
+            np.asarray(frontier["paper"])[:16], seeds)
+
+    def test_cap_below_batch_raises(self, mag_like, rng):
+        sampler = HeteroGraphSageSampler(
+            mag_like, sizes=[3], seed_type="paper", frontier_cap=4)
+        with pytest.raises(ValueError, match="batch size"):
+            sampler.sample(rng.choice(120, 8, replace=False))
+
+    def test_per_type_cap_dict(self, mag_like, rng):
+        sampler = HeteroGraphSageSampler(
+            mag_like, sizes=[3, 2], seed_type="paper",
+            frontier_cap={"author": 10})
+        frontier, _, _ = sampler.sample(rng.choice(120, 8, replace=False))
+        assert frontier["author"].shape[0] <= 10
+        # uncapped types keep their natural static capacity
+        assert frontier["paper"].shape[0] > 10
+
+    def test_rotation_fanout_cap_validated(self, mag_like):
+        with pytest.raises(ValueError, match="fanouts <= 128"):
+            HeteroGraphSageSampler(mag_like, sizes=[200],
+                                   seed_type="paper", sampling="rotation")
+
+    def test_reshuffle_on_exact_raises(self, mag_like):
+        s = HeteroGraphSageSampler(mag_like, sizes=[3], seed_type="paper")
+        with pytest.raises(ValueError, match="rotation/window"):
+            s.reshuffle()

@@ -202,9 +202,16 @@ class TestDataParallelTraining:
         with pytest.raises(TypeError, match="requires indices_rows"):
             rot(state, feat, None, indptr, indices, seeds, y,
                 jax.random.key(0))
-        with pytest.raises(TypeError, match="takes no indices_rows"):
-            exact(state, feat, None, indptr, indices, seeds, y,
-                  jax.random.key(0), rows)
+        # exact OPTIONALLY takes the un-shuffled rows view — the wide-
+        # fetch exact path draws the same Fisher-Yates positions from
+        # the same array order, so the step is bit-identical to the
+        # scattered exact step
+        s1, l1 = exact(state, feat, None, indptr, indices, seeds, y,
+                       jax.random.key(0))
+        s2, l2 = exact(state, feat, None, indptr, indices, seeds, y,
+                       jax.random.key(0), rows)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-6)
 
     def test_dp_grads_match_single_chip_average(self, planted):
         # one DP step with identical per-device batches == single-chip step

@@ -749,3 +749,191 @@ class TestSampleMultihopDedup:
         # every batch entry maps to its own id's slot
         for i, g in enumerate([3, 7, 3, 9, 7, 3]):
             assert n_id[blocals[i]] == g
+
+
+class TestExactWide:
+    """sample_layer_exact_wide: the wide-fetch exact draw. Same contract
+    as sample_layer (i.i.d. uniform min(deg,k)-subsets, distinct
+    positions) on every path — low-degree window fetch, capped hub
+    scatter, and the cond overflow fallback."""
+
+    @pytest.mark.parametrize("layout", ["pair", "overlap"])
+    def test_membership_counts_distinct(self, small_graph, layout):
+        from quiver_tpu.ops import (sample_layer_exact_wide, as_index_rows,
+                                    as_index_rows_overlapping)
+        indptr, indices = small_graph
+        nsets = neighbor_sets(indptr, indices)
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        k = 5
+        ix = jnp.asarray(indices)
+        if layout == "overlap":
+            rows, stride = as_index_rows_overlapping(ix), 128
+        else:
+            rows, stride = as_index_rows(ix), None
+        nbrs, counts = jax.jit(
+            sample_layer_exact_wide, static_argnums=(4, 6))(
+            jnp.asarray(indptr), ix, rows, jnp.asarray(seeds), k, KEY,
+            stride)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        deg = np.diff(indptr)
+        np.testing.assert_array_equal(counts, np.minimum(deg, k))
+        for i, v in enumerate(seeds):
+            got = nbrs[i][: counts[i]]
+            assert set(got.tolist()) <= nsets[v]
+            assert (nbrs[i][counts[i]:] == -1).all()
+
+    def _hub_graph(self):
+        # node 0: 400 distinct neighbors (hub, deg > any window);
+        # nodes 1..20: 6 neighbors each (low path)
+        indptr = np.concatenate([[0, 400], 400 + 6 * np.arange(1, 21)])
+        indices = np.concatenate(
+            [1000 + np.arange(400)] + [2000 + 10 * v + np.arange(6)
+                                       for v in range(1, 21)])
+        return indptr.astype(np.int64), indices.astype(np.int64)
+
+    @pytest.mark.parametrize("layout", ["pair", "overlap"])
+    def test_hub_path_membership_distinct(self, layout):
+        from quiver_tpu.ops import (sample_layer_exact_wide, as_index_rows,
+                                    as_index_rows_overlapping)
+        indptr, indices = self._hub_graph()
+        nsets = neighbor_sets(indptr, indices)
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        k = 7
+        ix = jnp.asarray(indices)
+        if layout == "overlap":
+            rows, stride = as_index_rows_overlapping(ix), 128
+        else:
+            rows, stride = as_index_rows(ix), None
+        nbrs, counts = sample_layer_exact_wide(
+            jnp.asarray(indptr), ix, rows, jnp.asarray(seeds), k, KEY,
+            stride=stride)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        deg = np.diff(indptr)
+        np.testing.assert_array_equal(counts, np.minimum(deg, k))
+        for i in range(len(seeds)):
+            got = nbrs[i][: counts[i]]
+            assert set(got.tolist()) <= nsets[i]
+            assert len(set(got.tolist())) == counts[i]
+
+    def test_hub_overflow_cond_fallback(self):
+        # every seed is the hub node; hub_cap=1 forces the cond branch
+        from quiver_tpu.ops import sample_layer_exact_wide, as_index_rows
+        indptr, indices = self._hub_graph()
+        nsets = neighbor_sets(indptr, indices)
+        seeds = np.zeros(16, dtype=np.int32)
+        ix = jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        nbrs, counts = sample_layer_exact_wide(
+            jnp.asarray(indptr), ix, rows, jnp.asarray(seeds), 5, KEY,
+            hub_cap=1)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        assert (counts == 5).all()
+        for i in range(16):
+            got = nbrs[i][:5]
+            assert set(got.tolist()) <= nsets[0]
+            assert len(set(got.tolist())) == 5
+
+    def test_hub_uniform_marginal(self):
+        # hub with 300 neighbors, k=2: each neighbor hit w.p. 2/300 per
+        # draw — exact i.i.d. without any reshuffle
+        from quiver_tpu.ops import sample_layer_exact_wide, as_index_rows
+        indptr = np.array([0, 300])
+        indices = np.arange(300)
+        ix = jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        seeds = jnp.zeros((256,), jnp.int32)
+        fn = jax.jit(sample_layer_exact_wide, static_argnums=4)
+        hits = np.zeros(300)
+        for t in range(40):
+            nbrs, _ = fn(jnp.asarray(indptr), ix, rows, seeds, 2,
+                         jax.random.fold_in(KEY, t))
+            ids, cnt = np.unique(np.asarray(nbrs), return_counts=True)
+            hits[ids[ids >= 0]] += cnt[ids >= 0]
+        freq = hits / hits.sum()
+        np.testing.assert_allclose(freq, 1 / 300, atol=1.7e-3)  # ~4 sigma
+
+    def test_low_uniform_marginal(self):
+        # low-degree row (10 nbrs, k=2): wide path must match
+        # sample_layer's 0.2 marginal
+        from quiver_tpu.ops import sample_layer_exact_wide, as_index_rows
+        indptr = np.array([0, 10])
+        indices = np.arange(10)
+        ix = jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        seeds = jnp.zeros((512,), jnp.int32)
+        fn = jax.jit(sample_layer_exact_wide, static_argnums=4)
+        hits = np.zeros(10)
+        for t in range(20):
+            nbrs, _ = fn(jnp.asarray(indptr), ix, rows, seeds, 2,
+                         jax.random.fold_in(KEY, t))
+            ids, cnt = np.unique(np.asarray(nbrs), return_counts=True)
+            hits[ids] += cnt
+        freq = hits / hits.sum()
+        np.testing.assert_allclose(freq, 0.1, atol=0.01)
+
+    def test_with_slots_original_csr(self):
+        from quiver_tpu.ops import sample_layer_exact_wide, as_index_rows
+        indptr, indices = self._hub_graph()
+        seeds = np.arange(len(indptr) - 1, dtype=np.int32)
+        ix = jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        nbrs, counts, slots = sample_layer_exact_wide(
+            jnp.asarray(indptr), ix, rows, jnp.asarray(seeds), 4, KEY,
+            with_slots=True)
+        nbrs, counts, slots = map(np.asarray, (nbrs, counts, slots))
+        for i in range(len(seeds)):
+            for j in range(counts[i]):
+                s = slots[i, j]
+                assert indptr[i] <= s < indptr[i + 1]
+                assert indices[s] == nbrs[i, j]
+            assert (slots[i, counts[i]:] == -1).all()
+
+    def test_masked_and_zero_degree(self):
+        from quiver_tpu.ops import sample_layer_exact_wide, as_index_rows
+        indptr = np.array([0, 0, 2, 2])
+        indices = np.array([5, 6])
+        ix = jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        nbrs, counts = sample_layer_exact_wide(
+            jnp.asarray(indptr), ix, rows, jnp.array([0, 1, -1], jnp.int32),
+            3, KEY)
+        counts = np.asarray(counts)
+        assert counts.tolist() == [0, 2, 0]
+        assert set(np.asarray(nbrs)[1][:2].tolist()) == {5, 6}
+
+    def test_multihop_exact_rows_dispatch(self, small_graph):
+        # method="exact" + indices_rows routes through the wide path and
+        # keeps the multihop contract (valid frontier, coherent layers)
+        from quiver_tpu.ops.sample_multihop import sample_multihop
+        from quiver_tpu.ops import as_index_rows_overlapping
+        indptr, indices = small_graph
+        nsets = neighbor_sets(indptr, indices)
+        seeds = jnp.asarray(np.arange(16, dtype=np.int32))
+        rows = as_index_rows_overlapping(jnp.asarray(indices))
+        n_id, layers = sample_multihop(
+            jnp.asarray(indptr), jnp.asarray(indices), seeds, [4, 3], KEY,
+            method="exact", indices_rows=rows, indices_stride=128)
+        n_id = np.asarray(n_id)
+        valid = n_id[n_id >= 0]
+        assert len(set(valid.tolist())) == len(valid)
+        # every sampled edge's endpoints resolve to a real graph edge
+        lay = layers[0]
+        nid0 = np.asarray(lay.n_id)
+        row, col = np.asarray(lay.row), np.asarray(lay.col)
+        for r, c in zip(row, col):
+            if c >= 0:
+                assert nid0[c] in nsets[nid0[r]]
+
+    def test_weighted_exact_rejects_rows(self, small_graph):
+        # exact WEIGHTED sampling would silently drop a built rows view
+        # — rejected loudly like the windowed coupled-parameter guards
+        from quiver_tpu.ops.sample_multihop import sample_multihop
+        from quiver_tpu.ops import as_index_rows
+        indptr, indices = small_graph
+        rows = as_index_rows(jnp.asarray(indices))
+        w = jnp.ones(indices.shape, jnp.float32)
+        with pytest.raises(ValueError, match="exact WEIGHTED"):
+            sample_multihop(jnp.asarray(indptr), jnp.asarray(indices),
+                            jnp.arange(4, dtype=jnp.int32), [3], KEY,
+                            edge_weight=w, method="exact",
+                            indices_rows=rows)

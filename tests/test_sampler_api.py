@@ -439,3 +439,78 @@ class TestNativeReindex:
         assert got[1] == want[1]
         np.testing.assert_array_equal(got[2], want[2])
         np.testing.assert_array_equal(got[3], want[3])
+
+
+class TestPinnedHostFallback:
+    """HOST-mode placement on backends without the pinned_host memory
+    kind must be LOUD: logged fallback by default, raise when
+    allow_fallback=False (reference fails loudly on UVA registration
+    failure, quiver.cu.hpp:16-26)."""
+
+    @staticmethod
+    def _no_pinned(monkeypatch):
+        import jax
+        real = jax.sharding.SingleDeviceSharding
+
+        def stub(dev, *a, **kw):
+            if kw.get("memory_kind") == "pinned_host":
+                raise NotImplementedError("no pinned_host on this backend")
+            return real(dev, *a, **kw)
+
+        monkeypatch.setattr(jax.sharding, "SingleDeviceSharding", stub)
+
+    def test_fallback_warns_and_still_samples(self, small_graph,
+                                              monkeypatch, caplog):
+        import logging
+        import quiver_tpu as qv
+        self._no_pinned(monkeypatch)
+        indptr, indices = small_graph
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        s = qv.GraphSageSampler(topo, [3, 2], mode="HOST")
+        with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+            n_id, bs, adjs = s.sample(np.arange(8, dtype=np.int32))
+        assert any("pinned_host" in r.message for r in caplog.records)
+        assert bs == 8 and len(adjs) == 2
+
+    def test_strict_raises(self, small_graph, monkeypatch):
+        import quiver_tpu as qv
+        self._no_pinned(monkeypatch)
+        indptr, indices = small_graph
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        s = qv.GraphSageSampler(topo, [3], mode="HOST",
+                                allow_fallback=False)
+        with pytest.raises(ValueError, match="pinned_host"):
+            s.sample(np.arange(4, dtype=np.int32))
+
+    def test_rotation_reshuffle_branch_warns(self, small_graph,
+                                             monkeypatch, caplog):
+        import logging
+        import quiver_tpu as qv
+        self._no_pinned(monkeypatch)
+        indptr, indices = small_graph
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        s = qv.GraphSageSampler(topo, [3], mode="HOST",
+                                sampling="rotation")
+        with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+            n_id, bs, adjs = s.sample(np.arange(8, dtype=np.int32))
+        assert any("shuffled rows" in r.message for r in caplog.records)
+        assert bs == 8
+
+
+def test_wide_exact_opt_out(small_graph):
+    """wide_exact=False keeps the zero-extra-copy scattered exact draw;
+    both forms draw identical neighbors under the same seed (the wide
+    path is bit-identical by construction)."""
+    import quiver_tpu as qv
+    indptr, indices = small_graph
+    topo = qv.CSRTopo(indptr=indptr, indices=indices)
+    wide = qv.GraphSageSampler(topo, [4, 3], seed=7)
+    narrow = qv.GraphSageSampler(topo, [4, 3], seed=7, wide_exact=False)
+    seeds = np.arange(8, dtype=np.int32)
+    n1, _, a1 = wide.sample(seeds)
+    n2, _, a2 = narrow.sample(seeds)
+    assert narrow._exact_rows is None and wide._exact_rows is not None
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+    for x, y in zip(a1, a2):
+        np.testing.assert_array_equal(np.asarray(x.edge_index),
+                                      np.asarray(y.edge_index))
