@@ -1,0 +1,120 @@
+"""Hetero sampler micro-benchmark: per-mode SEPS on a MAG240M-shaped
+3-relation graph (paper-cites-paper, author-writes-paper,
+inst-employs-author).
+
+Records the r4 claim that the hetero path's rotation/window/wide-exact
+modes run at rotation-like cost (wide row fetches per relation) vs the
+scattered exact baseline. The reference never samples relations
+natively (it trains MAG240M on the homogeneous projection,
+train_quiver_multi_node.py:90-93), so the homogeneous rotation number
+on the same paper-cites-paper relation is printed as the cost anchor.
+
+Usage: python benchmarks/bench_hetero.py [--papers N] [--batches K]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def lognormal_csr(rng, n_rows, n_src, avg_deg):
+    deg = np.minimum(
+        rng.lognormal(np.log(avg_deg), 1.0, n_rows).astype(np.int64),
+        10_000)
+    indptr = np.zeros(n_rows + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n_src, int(indptr[-1]), dtype=np.int32)
+    return indptr, indices
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--papers", type=int, default=1_200_000)
+    p.add_argument("--authors", type=int, default=800_000)
+    p.add_argument("--insts", type=int, default=30_000)
+    p.add_argument("--avg-deg", type=int, default=20)
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--batches", type=int, default=20)
+    p.add_argument("--sizes", type=int, nargs="+", default=[15, 10])
+    args = p.parse_args()
+
+    from _common import configure_jax
+    jax = configure_jax()
+    import quiver_tpu as qv
+    from quiver_tpu.hetero import HeteroCSRTopo, HeteroGraphSageSampler
+
+    rng = np.random.default_rng(0)
+    rels_np = {
+        ("paper", "cites", "paper"): lognormal_csr(
+            rng, args.papers, args.papers, args.avg_deg),
+        ("author", "writes", "paper"): lognormal_csr(
+            rng, args.papers, args.authors, 3),
+        ("inst", "employs", "author"): lognormal_csr(
+            rng, args.authors, args.insts, 2),
+    }
+    topo = HeteroCSRTopo(
+        {et: qv.CSRTopo(indptr=ip, indices=ix)
+         for et, (ip, ix) in rels_np.items()},
+        {"paper": args.papers, "author": args.authors,
+         "inst": args.insts})
+    edges = sum(len(ix) for _, ix in rels_np.values())
+    print(f"hetero graph: {edges} edges over 3 relations")
+
+    def measure(label, **kwargs):
+        s = HeteroGraphSageSampler(topo, sizes=args.sizes,
+                                   seed_type="paper", **kwargs)
+        seeds = rng.choice(args.papers, args.batch,
+                           replace=False).astype(np.int32)
+        out = s.sample(seeds)           # compile + (maybe) reshuffle
+        jax.block_until_ready(out[0]["paper"])
+        total = 0
+        t0 = time.perf_counter()
+        for i in range(args.batches):
+            seeds = rng.choice(args.papers, args.batch,
+                               replace=False).astype(np.int32)
+            frontier, _, layers = s.sample(seeds)
+            total += sum(int(np.asarray(c)) for l in layers
+                         for c in l.counts.values())
+        jax.block_until_ready(frontier["paper"])
+        dt = time.perf_counter() - t0
+        print(f"[hetero {label}] ~{total} frontier nodes in {dt:.2f}s "
+              f"-> {total / dt / 1e6:.2f} M nodes/s")
+        return dt
+
+    for label, kwargs in [
+        ("exact-wide overlap", dict(layout="overlap")),
+        ("exact-scatter", dict(wide_exact=False)),
+        ("rotation overlap", dict(sampling="rotation", layout="overlap")),
+        ("rotation overlap butterfly",
+         dict(sampling="rotation", layout="overlap", shuffle="butterfly")),
+        ("window overlap", dict(sampling="window", layout="overlap")),
+    ]:
+        measure(label, **kwargs)
+
+    # homogeneous rotation anchor on the big relation
+    ip, ix = rels_np[("paper", "cites", "paper")]
+    h = qv.GraphSageSampler(qv.CSRTopo(indptr=ip, indices=ix),
+                            args.sizes, sampling="rotation",
+                            layout="overlap")
+    seeds = rng.choice(args.papers, args.batch, replace=False)
+    out = h.sample(seeds)
+    jax.block_until_ready(out[0])
+    t0 = time.perf_counter()
+    total = 0
+    for i in range(args.batches):
+        seeds = rng.choice(args.papers, args.batch, replace=False)
+        n_id, _, adjs = h.sample(seeds)
+        total += sum(int(np.asarray(a.mask).sum()) for a in adjs)
+    jax.block_until_ready(n_id)
+    dt = time.perf_counter() - t0
+    print(f"[homog rotation anchor] {total} edges in {dt:.2f}s -> "
+          f"SEPS = {total / dt / 1e6:.2f} M")
+
+
+if __name__ == "__main__":
+    main()

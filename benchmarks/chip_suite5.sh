@@ -25,5 +25,13 @@ step python -u benchmarks/bench_e2e.py --method exact
 step python -u benchmarks/bench_mixed.py --sampling rotation
 step python -u benchmarks/bench_mixed.py --sampling exact
 
+# 5. hetero sampler per-mode cost (r4 perf modes) vs homog rotation anchor
+step python -u benchmarks/bench_hetero.py
+
+# 6. does the TPU compiler take pinned_host topology in the sampler jit?
+#    (CPU backend accepts the placement then fails the compile — gated in
+#    _pinned_put; this settles the TPU side)
+step python -u benchmarks/host_mode_probe.py
+
 date | tee -a "$LOG"
 echo "chip suite 5 (round-4 additions) complete -> $LOG"

@@ -1,0 +1,47 @@
+"""Does the TPU compiler support sampling against pinned_host topology?
+
+The HOST tier (UVA analogue) places indptr/indices on pinned host
+memory and jits the sampler over them. The CPU backend ACCEPTS that
+placement and then fails compiling any mixed-memory-space gather —
+which is why `_pinned_put` gates the placement to TPU. This probe
+settles the TPU side empirically: strict mode (allow_fallback=False)
+either samples fine (host-offload gather works — keep the tier) or
+raises at compile (record it; the tier then needs an explicit
+device_put stream step or must stay a loud fallback).
+
+Run on chip via chip_suite5. Small graph — the probe answers a
+compiler capability question, not a bandwidth one.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from _common import configure_jax
+    jax = configure_jax()
+    import quiver_tpu as qv
+
+    rng = np.random.default_rng(0)
+    ei = rng.integers(0, 50_000, (2, 400_000))
+    topo = qv.CSRTopo(edge_index=ei)
+    for sampling, layout in [("exact", "overlap"), ("rotation", "overlap")]:
+        s = qv.GraphSageSampler(topo, [15, 10], mode="HOST",
+                                sampling=sampling, layout=layout,
+                                allow_fallback=False)
+        try:
+            n_id, bs, adjs = s.sample(np.arange(256, dtype=np.int32))
+            jax.block_until_ready(n_id)
+            print(f"[host-probe {sampling}/{layout}] OK — pinned_host "
+                  f"topology sampled on {jax.devices()[0].platform}")
+        except Exception as e:
+            print(f"[host-probe {sampling}/{layout}] FAILED: "
+                  f"{type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -106,7 +106,7 @@ class HeteroGraphSageSampler:
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
                  seed_type: str, seed: int = 0, sampling: str = "exact",
                  layout: str = "pair", shuffle: str = "sort",
-                 frontier_cap=None):
+                 frontier_cap=None, wide_exact: bool = True):
         self.topo = topo
         self.seed_type = seed_type
         self.sizes = [s if isinstance(s, dict)
@@ -128,6 +128,9 @@ class HeteroGraphSageSampler:
         if frontier_cap is not None and not isinstance(frontier_cap, dict):
             frontier_cap = {t: int(frontier_cap) for t in topo.node_types}
         self.frontier_cap = frontier_cap
+        # wide_exact=False: skip the per-relation layout views (+E/+2E
+        # memory each) and keep the zero-extra-copy scattered exact draw
+        self.wide_exact = wide_exact
         self._key = jax.random.key(seed)
         self._fn_cache = {}
         self._rows = None        # {edge_type: rows view}
@@ -271,7 +274,7 @@ class HeteroGraphSageSampler:
         if self._rows is None:
             if self.sampling in ("rotation", "window"):
                 self.reshuffle()
-            else:
+            elif self.wide_exact:
                 # exact: static layout views of the un-shuffled indices
                 # route every relation through the wide-fetch exact path
                 self._rows = {et: self._as_rows(jnp.asarray(t.indices))

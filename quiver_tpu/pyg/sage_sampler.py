@@ -34,25 +34,35 @@ T_co = TypeVar("T_co", covariant=True)
 
 
 def _pinned_put(arrays, dev, allow_fallback, what):
-    """Place ``arrays`` on the device's pinned host memory. Backends
-    without the ``pinned_host`` memory kind get a LOUD fallback: warn
-    via the package logger and return None (caller keeps its default
-    placement) when ``allow_fallback``, else raise — a silently
-    different performance regime is the failure mode the reference
-    guards with its CUDA check macros (quiver.cu.hpp:16-26)."""
+    """Place ``arrays`` on the device's pinned host memory. Non-TPU
+    backends (and TPU backends without the memory kind) get a LOUD
+    fallback: warn via the package logger and return None (caller keeps
+    its default placement) when ``allow_fallback``, else raise — a
+    silently different performance regime is the failure mode the
+    reference guards with its CUDA check macros (quiver.cu.hpp:16-26).
+
+    The platform gate exists because e.g. the CPU backend ACCEPTS the
+    ``pinned_host`` placement and then fails at compile time on any
+    computation mixing host- and default-space operands — the worst of
+    both: placement succeeds, every later sample() raises. Only the TPU
+    compiler has the host-offload support this tier targets."""
     try:
+        if getattr(dev, "platform", None) != "tpu":
+            raise NotImplementedError(
+                f"host-offload placement is TPU-only (backend: "
+                f"{getattr(dev, 'platform', 'unknown')})")
         sh = jax.sharding.SingleDeviceSharding(
             dev, memory_kind="pinned_host")
         return [jax.device_put(a, sh) for a in arrays]
     except (ValueError, NotImplementedError) as e:
         if not allow_fallback:
             raise ValueError(
-                "HOST mode: this backend has no 'pinned_host' memory "
-                f"kind (placing {what}): {e}. Default placement is a "
+                "HOST mode: no usable 'pinned_host' memory kind here "
+                f"(placing {what}): {e}. Default placement is a "
                 "different performance regime — construct the sampler "
                 "with allow_fallback=True to accept it") from e
-        _log("HOST mode: no 'pinned_host' memory kind on this backend; "
-             "%s falls back to default placement (a different "
+        _log("HOST mode: no usable 'pinned_host' memory kind on this "
+             "backend; %s falls back to default placement (a different "
              "performance regime)", what)
         return None
 
@@ -259,21 +269,45 @@ class GraphSageSampler:
             if got is not None:
                 self._weight_placed = got[0]
 
+    @staticmethod
+    def _rows_np(flat, width=128, overlap=False):
+        """numpy twin of ops.as_index_rows(_overlapping) — same layout
+        formulas (asserted equal in tests) built WITHOUT touching device
+        memory, for HOST mode where the E/2E view must never transit
+        HBM."""
+        e = flat.shape[0]
+        nrows = (e + 2 * width - 1) // width + 1
+        pad = nrows * width - e
+        base = np.concatenate(
+            [flat, np.zeros((pad,), flat.dtype)]).reshape(nrows, width)
+        if not overlap:
+            return base
+        nxt = np.concatenate([base[1:], np.zeros_like(base[:1])])
+        return np.concatenate([base, nxt], axis=1)
+
     def _ensure_exact_rows(self):
         """Layout view (pair/overlap per ``self.layout``) of the placed,
         UN-shuffled indices — the wide-fetch exact path's input. Built
-        once; HOST mode keeps it host-resident like the flat array."""
+        once. HOST mode builds it host-side (numpy) and pins it WITHOUT
+        ever committing the E/2E array to device HBM — the mode exists
+        because the topology doesn't fit there."""
         if self._exact_rows is not None:
             return self._exact_rows
-        from ..ops.sample import as_index_rows, as_index_rows_overlapping
-        as_rows = (as_index_rows_overlapping if self.layout == "overlap"
-                   else as_index_rows)
-        rows = as_rows(jnp.asarray(self._placed[1]))
         if self.mode == "HOST":
-            got = _pinned_put([rows], list(rows.devices())[0],
-                              self.allow_fallback, "the exact rows view")
-            if got is not None:
-                rows = got[0]
+            rows_np = self._rows_np(np.asarray(self._placed[1]),
+                                    overlap=self.layout == "overlap")
+            dev = self.device
+            if dev is None or isinstance(dev, int):
+                dev = jax.devices()[self.device or 0]
+            got = _pinned_put([rows_np], dev, self.allow_fallback,
+                              "the exact rows view")
+            rows = got[0] if got is not None else rows_np
+        else:
+            from ..ops.sample import (as_index_rows,
+                                      as_index_rows_overlapping)
+            as_rows = (as_index_rows_overlapping
+                       if self.layout == "overlap" else as_index_rows)
+            rows = as_rows(jnp.asarray(self._placed[1]))
         self._exact_rows = rows
         return rows
 

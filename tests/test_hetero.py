@@ -264,3 +264,19 @@ class TestHeteroPerfModes:
         s = HeteroGraphSageSampler(mag_like, sizes=[3], seed_type="paper")
         with pytest.raises(ValueError, match="rotation/window"):
             s.reshuffle()
+
+    def test_wide_exact_opt_out_identical(self, mag_like, rng):
+        # wide_exact=False keeps the scattered exact draw; identical
+        # results under the same seed (the wide path is bit-identical)
+        a = HeteroGraphSageSampler(mag_like, sizes=[3, 2],
+                                   seed_type="paper", seed=5)
+        b = HeteroGraphSageSampler(mag_like, sizes=[3, 2],
+                                   seed_type="paper", seed=5,
+                                   wide_exact=False)
+        seeds = rng.choice(120, 8, replace=False)
+        fa, _, la = a.sample(seeds)
+        fb, _, lb = b.sample(seeds)
+        assert a._rows is not None and b._rows is None
+        for t in fa:
+            np.testing.assert_array_equal(np.asarray(fa[t]),
+                                          np.asarray(fb[t]))
