@@ -31,8 +31,10 @@ def synthetic(n, avg_deg, dim, classes, seed=0):
     centers = rng.standard_normal((classes, dim)).astype(np.float32)
     feat = centers[labels] + \
         0.5 * rng.standard_normal((n, dim)).astype(np.float32)
-    train_idx = rng.choice(n, n // 10, replace=False).astype(np.int32)
-    return indptr, indices, feat, labels, train_idx
+    perm = rng.permutation(n)
+    train_idx = perm[: n // 10].astype(np.int32)
+    test_idx = perm[n // 10: n // 10 + n // 20].astype(np.int32)
+    return indptr, indices, feat, labels, train_idx, test_idx
 
 
 def main():
@@ -65,6 +67,8 @@ def main():
                         "cheaper masked swap network)")
     p.add_argument("--data-parallel", action="store_true",
                    help="shard the batch over all local devices")
+    p.add_argument("--eval-batches", type=int, default=20,
+                   help="test-accuracy batches after training (0 = skip)")
     p.add_argument("--npz", "--data-dir", dest="npz", default=None,
                    help="real dataset: an .npz bundle or a directory of "
                         ".npy files (keys edge_index, feat, labels, "
@@ -102,12 +106,14 @@ def main():
         ds = qv.from_numpy_dir(args.npz)
         topo = ds.csr_topo
         feat_np, labels, train_idx = ds.feat, ds.labels, ds.train_idx
+        test_idx = (ds.test_idx if ds.test_idx is not None
+                    else ds.valid_idx)
         indptr = np.asarray(topo.indptr)
         indices = np.asarray(topo.indices)
         if args.classes < ds.num_classes:
             args.classes = ds.num_classes
     else:
-        indptr, indices, feat_np, labels, train_idx = synthetic(
+        indptr, indices, feat_np, labels, train_idx, test_idx = synthetic(
             args.nodes, args.avg_deg, args.dim, args.classes)
         topo = qv.CSRTopo(indptr=indptr, indices=indices)
 
@@ -232,6 +238,57 @@ def main():
         dt = time.perf_counter() - t0
         print(f"epoch {epoch}: loss {epoch_loss / max(nb, 1):.4f}  "
               f"{dt:.2f}s  ({nb * bs / dt:.0f} seeds/s)")
+
+    # -- sampled-neighborhood test accuracy (the reference's flagship
+    # example reports ~0.787 on ogbn-products this way,
+    # dist_sampling_ogb_products_quiver.py:1) --
+    if args.eval_batches and test_idx is not None and len(test_idx) < bs:
+        print(f"eval skipped: {len(test_idx)} test nodes < batch {bs} "
+              "(lower --batch or --eval-batches 0 to silence)")
+    if args.eval_batches and test_idx is not None and len(test_idx) >= bs:
+        if sample_fn is not None:
+            eval_sample = sample_fn     # tiered path: reuse its jit
+        else:
+            @jax.jit
+            def eval_sample(indptr, indices, seeds, key, rows=None):
+                n_id, layers = sample_multihop(
+                    indptr, indices, seeds, sizes, key,
+                    method=args.sampling, indices_rows=rows,
+                    indices_stride=stride if rows is not None else None,
+                    seeds_dense=True)
+                return n_id, layers_to_adjs(layers, bs, sizes)
+
+        @jax.jit
+        def eval_apply(params, x, adjs):
+            return model.apply(params, x, adjs, train=False)
+
+        if args.epochs == 0:
+            # no training epoch built a rows view yet
+            rows = refresh_rows(0) if windowed else exact_rows
+        # else: the last epoch's rows/permuted_j pair is still in scope
+        # and any consistent shuffle is valid for eval — no extra
+        # reshuffle
+        correct = tot = 0
+        ev = 0
+        for lo in range(0, len(test_idx) - bs + 1, bs):
+            if ev >= args.eval_batches:
+                break
+            ev += 1
+            batch_idx = test_idx[lo:lo + bs]
+            seeds = jnp.asarray(batch_idx.astype(np.int32))
+            n_id, adjs = eval_sample(indptr_j, permuted_j, seeds,
+                                     jax.random.key(10_000_000 + ev), rows)
+            x = (masked_feature_gather(feat_j, n_id, forder)
+                 if fully_cached else jnp.asarray(feature[n_id]))
+            pred = np.asarray(
+                jnp.argmax(eval_apply(state.params, x, adjs)[:bs], -1))
+            y = np.asarray(labels[batch_idx], dtype=np.float64)
+            ok = np.isfinite(y)          # papers100M-style NaN unlabeled
+            correct += int((pred[ok] == y[ok].astype(np.int64)).sum())
+            tot += int(ok.sum())
+        if tot:
+            print(f"test accuracy: {correct / tot:.4f} "
+                  f"({tot} labeled test nodes, {ev} batches)")
 
 
 if __name__ == "__main__":
