@@ -304,12 +304,27 @@ def main():
     # already covers the butterfly arm)
     window_seps = measure(side_batches, "window", layout, 11,
                           shuffle="sort")
+    # window draws i.i.d. subsets at rotation's fetch cost — the
+    # statistically STRONGER mode. If its short-epoch side figure beats
+    # the rotation winner, measure it at full epoch length and let it
+    # take the headline, labeled. (Accuracy parity is recorded for all
+    # arms; the extra full-epoch run is only paid when window leads.)
+    mode = "rotation"
+    if window_seps > seps:
+        window_full = measure(batches, "window", layout, 60,
+                              shuffle=shuffle)
+        if window_full > seps:
+            # same winner's-curse discipline as the rotation sweep: the
+            # selection run decided, a FRESH run (already compiled) is
+            # the reported headline
+            mode = "window"
+            seps = measure(batches, "window", layout, 61, shuffle=shuffle)
     out = {
         "metric": "sampled-edges/sec (ogbn-products-scale, fanout [15,10,5], batch 1024)",
         "value": round(seps, 1),
         "unit": "edges/s",
         "vs_baseline": round(seps / BASELINE_SEPS, 3),
-        "mode": "rotation",
+        "mode": mode,
         "layout": layout,
         "shuffle": shuffle,
         "exact_mode_value": round(exact_seps, 1),
