@@ -44,6 +44,11 @@ def main():
                         "rows cached in HBM (rest in the host tier)")
     p.add_argument("--prefetch", action="store_true",
                    help="with --tiered: double-buffer via prefetch()")
+    p.add_argument("--offload", action="store_true",
+                   help="with --tiered: host_placement='offload' — the "
+                        "cold tier stays a pinned_host jax array and "
+                        "the whole lookup fuses into one dispatch "
+                        "(UVA-gather analogue; TPU/GPU only)")
     args = p.parse_args()
 
     from _common import configure_jax
@@ -67,9 +72,12 @@ def main():
         if args.bf16:
             feat_np = feat_np.astype(jnp.bfloat16)
         row_bytes = args.dim * feat_np.dtype.itemsize
-        f = qv.Feature(device_cache_size=int(args.rows * frac) * row_bytes)
+        f = qv.Feature(device_cache_size=int(args.rows * frac) * row_bytes,
+                       host_placement="offload" if args.offload
+                       else "numpy")
         f.from_cpu_tensor(feat_np)
         label = (f"tiered cache={frac:.0%}"
+                 + (" offload" if args.offload else "")
                  + (" prefetch" if args.prefetch else " sync"))
         ids = [make_ids(jax.random.fold_in(key, 10 + i))
                for i in range(args.iters)]

@@ -33,5 +33,11 @@ step python -u benchmarks/bench_hetero.py
 #    _pinned_put; this settles the TPU side)
 step python -u benchmarks/host_mode_probe.py
 
+# 7. fused offload host tier (pinned_host cold rows, one-dispatch lookup)
+#    vs the numpy host tier — only meaningful if the host probe (step 6)
+#    says the TPU compiler takes pinned_host operands
+step python -u benchmarks/bench_feature.py --tiered 0.2 --rows 300000 --batch 20000 --iters 5 --offload
+step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5 --offload
+
 date | tee -a "$LOG"
 echo "chip suite 5 (round-4 additions) complete -> $LOG"

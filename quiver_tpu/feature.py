@@ -72,10 +72,13 @@ class Feature:
                  cache_policy: str = "device_replicate",
                  csr_topo: Optional[CSRTopo] = None,
                  mesh: Optional[Mesh] = None,
-                 dtype=None):
+                 dtype=None,
+                 host_placement: str = "numpy"):
         if cache_policy not in ("device_replicate", "p2p_clique_replicate",
                                 "shard"):
             raise ValueError(f"unknown cache_policy {cache_policy!r}")
+        if host_placement not in ("numpy", "offload"):
+            raise ValueError(f"unknown host_placement {host_placement!r}")
         self.rank = rank
         self.device_list = list(device_list) if device_list else None
         self.device_cache_size = device_cache_size
@@ -83,15 +86,24 @@ class Feature:
         self.csr_topo = csr_topo
         self.mesh = mesh
         self.dtype = dtype
+        # host_placement="offload": keep the cold tier as a pinned_host
+        # jax array and FUSE the whole tiered lookup into one jitted
+        # dispatch (device rows from HBM, cold rows gathered by XLA
+        # straight from pinned host memory — the reference's UVA gather
+        # semantics, quiver_feature.cu:174-293). Requires a backend with
+        # usable host-offload (TPU/GPU; loud numpy fallback elsewhere).
+        self.host_placement = host_placement
         self.feature_order = None      # old id -> storage row
         self.cache_rows = 0
         self.device_part = None        # jnp [cache_rows, dim]
         self.host_part = None          # np  [rest, dim]
+        self._host_offload = None      # pinned_host jnp [rest, dim]
         self.mmap_array = None
         self.disk_map = None
         self._gather_cached = None
         self._translate = None
         self._lookup_cached = None
+        self._lookup_tiered = None
         self._pool = None              # prefetch staging thread
 
     # -- sizing (reference feature.py:74-82) --------------------------------
@@ -126,8 +138,27 @@ class Feature:
         self._place(cache_part)
         self.host_part = np.ascontiguousarray(host_part) \
             if host_part.shape[0] else None
+        self._maybe_offload_host()
         self._build_gather()
         return self
+
+    def _maybe_offload_host(self):
+        """host_placement="offload": pin the cold tier to host memory as
+        a jax array so the tiered lookup fuses into one dispatch. Loud
+        numpy fallback on backends without usable host-offload."""
+        if self.host_placement != "offload" or self.host_part is None:
+            return
+        from .utils.placement import pinned_put
+        dev = jax.devices()[self.rank if self.rank < len(jax.devices())
+                            else 0]
+        got = pinned_put([self.host_part], dev, True,
+                         "the Feature host tier")
+        if got is not None:
+            # the pinned array OWNS the cold tier — dropping the numpy
+            # copy keeps host residency at 1x (pickling round-trips the
+            # contents back through numpy, __getstate__)
+            self._host_offload = got[0]
+            self.host_part = None
 
     def from_mmap(self, np_array, device_config: DeviceConfig):
         """Construct from pre-partitioned parts (reference feature.py:95-192).
@@ -146,6 +177,7 @@ class Feature:
             else np.ascontiguousarray(host)
         if np_array is not None and self.host_part is None and not self.cache_rows:
             self.host_part = np.ascontiguousarray(np_array)
+        self._maybe_offload_host()
         self._build_gather()
         return self
 
@@ -203,9 +235,35 @@ class Feature:
         # sits behind a network tunnel
         self._lookup_cached = jax.jit(lookup_cached)
 
+        def lookup_tiered(dev_part, host_part, ids, order):
+            # one dispatch for the WHOLE tiered lookup: hot rows from
+            # the HBM cache, cold rows gathered by XLA directly from
+            # the (pinned host) cold tier — no Python round trip, no
+            # data-dependent shapes. Semantics identical to the numpy
+            # path (tested); placement makes it UVA-like on TPU/GPU.
+            t = translate(ids, order)
+            hot = t < cache_rows
+            cold_n = host_part.shape[0]
+            cold = jnp.clip(t - cache_rows, 0, max(cold_n - 1, 0))
+            cold_rows = jnp.take(host_part, cold, axis=0)
+            if dev_part is None:
+                return cold_rows
+            hot_rows = gather_cached(dev_part, jnp.where(hot, t, 0))
+            return jnp.where(hot[:, None], hot_rows, cold_rows)
+
+        self._lookup_tiered = jax.jit(lookup_tiered)
+
     # -- lookup (reference feature.py:296-333) ------------------------------
     def __getitem__(self, node_idx):
         ids = jnp.asarray(node_idx)
+        if self._host_offload is not None and self.mmap_array is None:
+            # fused offload path: one dispatch, cold rows read from
+            # pinned host memory by XLA (UVA-gather analogue). Checked
+            # FIRST: a successful offload owns the cold tier
+            # (host_part is None then).
+            return self._lookup_tiered(self.device_part,
+                                       self._host_offload, ids,
+                                       self.feature_order)
         if self.host_part is None and self.mmap_array is None:
             return self._lookup_cached(self.device_part, ids,
                                        self.feature_order)
@@ -288,13 +346,16 @@ class Feature:
     # -- shape protocol ------------------------------------------------------
     @property
     def shape(self):
-        rows = self.cache_rows + (0 if self.host_part is None
-                                  else self.host_part.shape[0])
+        cold = (self.host_part if self.host_part is not None
+                else self._host_offload)
+        rows = self.cache_rows + (0 if cold is None else cold.shape[0])
         dim = None
         if self.device_part is not None:
             dim = self.device_part.shape[1]
         elif self.host_part is not None:
             dim = self.host_part.shape[1]
+        elif self._host_offload is not None:
+            dim = self._host_offload.shape[1]
         return (rows, dim)
 
     def size(self, dim: int) -> int:
@@ -307,7 +368,13 @@ class Feature:
     def __getstate__(self):
         state = {k: getattr(self, k) for k in self.__dict__
                  if k not in ("_gather_cached", "_translate",
-                              "_lookup_cached", "_pool")}
+                              "_lookup_cached", "_lookup_tiered",
+                              "_host_offload", "_pool")}
+        # the pinned_host array doesn't pickle; round-trip its contents
+        # through numpy and re-place on load
+        if self._host_offload is not None and state.get("host_part") is None:
+            state["host_part"] = np.asarray(
+                jax.device_get(self._host_offload))
         return state
 
     def __setstate__(self, state):
@@ -315,7 +382,10 @@ class Feature:
         self._gather_cached = None
         self._translate = None
         self._lookup_cached = None
+        self._lookup_tiered = None
+        self._host_offload = None
         self._pool = None
+        self._maybe_offload_host()
         self._build_gather()
 
     # -- process sharing compat ---------------------------------------------

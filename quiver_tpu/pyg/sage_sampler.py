@@ -26,49 +26,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..debug import log as _log
 from ..ops.sample import compact_layer, sample_layer, sample_prob
 from ..utils import CSRTopo
 
 T_co = TypeVar("T_co", covariant=True)
 
 
-def _pinned_put(arrays, dev, allow_fallback, what):
-    """Place ``arrays`` on the device's pinned host memory. Backends
-    without usable host-offload get a LOUD fallback: warn via the
-    package logger and return None (caller keeps its default placement)
-    when ``allow_fallback``, else raise — a silently different
-    performance regime is the failure mode the reference guards with
-    its CUDA check macros (quiver.cu.hpp:16-26).
-
-    The CPU backend is explicitly gated out: it ACCEPTS the
-    ``pinned_host`` placement and then fails at compile time on any
-    computation mixing host- and default-space operands — the worst of
-    both: placement succeeds, every later sample() raises. TPU/GPU
-    backends pass through (the TPU side is probed on chip by
-    benchmarks/host_mode_probe.py)."""
-    try:
-        if getattr(dev, "platform", None) == "cpu":
-            # the CPU backend is the measured-broken case; TPU is
-            # settled on chip by benchmarks/host_mode_probe.py and GPU
-            # backends support the memory kind natively
-            raise NotImplementedError(
-                "the CPU backend accepts pinned_host placement and then "
-                "fails compiling mixed-memory-space ops")
-        sh = jax.sharding.SingleDeviceSharding(
-            dev, memory_kind="pinned_host")
-        return [jax.device_put(a, sh) for a in arrays]
-    except (ValueError, NotImplementedError) as e:
-        if not allow_fallback:
-            raise ValueError(
-                "HOST mode: no usable 'pinned_host' memory kind here "
-                f"(placing {what}): {e}. Default placement is a "
-                "different performance regime — construct the sampler "
-                "with allow_fallback=True to accept it") from e
-        _log("HOST mode: no usable 'pinned_host' memory kind on this "
-             "backend; %s falls back to default placement (a different "
-             "performance regime)", what)
-        return None
+from ..utils.placement import pinned_put as _pinned_put  # shared helper
 
 
 @jax.tree_util.register_pytree_node_class

@@ -1,0 +1,46 @@
+"""Host-offload (pinned host memory) placement with loud fallback.
+
+Shared by the sampler's HOST mode and the Feature store's offload host
+tier. A silently different performance regime is the failure mode the
+reference guards with its CUDA check macros (quiver.cu.hpp:16-26), so
+backends without usable host-offload either warn via the package
+logger (allow_fallback=True) or raise.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..debug import log as _log
+
+
+def pinned_put(arrays, dev, allow_fallback, what):
+    """Place ``arrays`` on ``dev``'s pinned host memory. Returns the
+    placed list, or None after a LOUD log when ``allow_fallback`` and
+    the placement is unusable; raises otherwise.
+
+    The CPU backend is explicitly gated out: it ACCEPTS the
+    ``pinned_host`` placement and then fails at compile time on any
+    computation mixing host- and default-space operands — the worst of
+    both: placement succeeds, every later use raises. TPU/GPU backends
+    pass through (the TPU side is probed on chip by
+    benchmarks/host_mode_probe.py)."""
+    try:
+        if getattr(dev, "platform", None) == "cpu":
+            raise NotImplementedError(
+                "the CPU backend accepts pinned_host placement and then "
+                "fails compiling mixed-memory-space ops")
+        sh = jax.sharding.SingleDeviceSharding(
+            dev, memory_kind="pinned_host")
+        return [jax.device_put(a, sh) for a in arrays]
+    except (ValueError, NotImplementedError) as e:
+        if not allow_fallback:
+            raise ValueError(
+                "no usable 'pinned_host' memory kind here "
+                f"(placing {what}): {e}. Default placement is a "
+                "different performance regime — pass allow_fallback="
+                "True to accept it") from e
+        _log("no usable 'pinned_host' memory kind on this backend; "
+             "%s falls back to default placement (a different "
+             "performance regime)", what)
+        return None

@@ -438,3 +438,46 @@ class TestPartitioner:
         np.testing.assert_array_equal(cache[0], cache0)
         # book consistent with res
         assert (book[res[1]] == 1).all()
+
+
+class TestOffloadHostTier:
+    """host_placement="offload": the fused one-dispatch tiered lookup.
+    Placement itself is TPU/GPU-only (CPU backend gated out, loud
+    fallback), but the fused lookup's SEMANTICS are testable anywhere
+    by calling it with unpinned arrays."""
+
+    def test_fused_lookup_matches_numpy_path(self):
+        f, feat = make_feature(cache_frac=0.3)
+        ids = jnp.asarray(np.array([0, 29, 30, 31, 99, 0, 65]))
+        want = np.asarray(f[ids])                       # numpy host path
+        got = np.asarray(f._lookup_tiered(
+            f.device_part, jnp.asarray(f.host_part), ids,
+            f.feature_order))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_fused_lookup_no_device_cache(self):
+        f, feat = make_feature(cache_frac=0.0)
+        assert f.device_part is None
+        ids = jnp.asarray(np.array([3, 0, 99, 42]))
+        want = np.asarray(f[ids])
+        got = np.asarray(f._lookup_tiered(
+            None, jnp.asarray(f.host_part), ids, f.feature_order))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_offload_on_cpu_falls_back_loudly(self, caplog):
+        import logging
+        rng = np.random.default_rng(0)
+        feat = rng.standard_normal((50, 8)).astype(np.float32)
+        f = qv.Feature(device_cache_size=10 * 8 * 4,
+                       host_placement="offload")
+        with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+            f.from_cpu_tensor(feat)
+        assert f._host_offload is None                  # CPU: gated out
+        assert any("pinned_host" in r.message for r in caplog.records)
+        ids = np.array([0, 9, 10, 49])
+        np.testing.assert_allclose(np.asarray(f[jnp.asarray(ids)]),
+                                   feat[ids], rtol=1e-6)
+
+    def test_bad_host_placement_rejected(self):
+        with pytest.raises(ValueError, match="host_placement"):
+            qv.Feature(host_placement="gpu")
