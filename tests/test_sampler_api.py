@@ -548,3 +548,23 @@ def test_host_mode_exact_wide_samples(small_graph):
     assert len(set(valid.tolist())) == len(valid)
     for a in adjs:
         assert (np.asarray(a.edge_index)[0][np.asarray(a.mask)] >= 0).all()
+
+
+def test_ipc_handle_carries_layout_and_shuffle(small_graph):
+    """r4 (ADVICE r3): the IPC tuple round-trips layout/shuffle so a
+    rebuilt sampler doesn't silently revert to pair/sort; old 7-tuple
+    handles still load with ctor defaults."""
+    import quiver_tpu as qv
+    indptr, indices = small_graph
+    topo = qv.CSRTopo(indptr=indptr, indices=indices)
+    s = qv.GraphSageSampler(topo, [4, 2], sampling="rotation",
+                            layout="overlap", shuffle="butterfly")
+    s2 = qv.GraphSageSampler.lazy_from_ipc_handle(s.share_ipc())
+    assert s2.layout == "overlap" and s2.shuffle == "butterfly"
+    assert s2.sampling == "rotation"
+    # back-compat: an old-style 7-tuple gets ctor defaults
+    old = s.share_ipc()[:7]
+    s3 = qv.GraphSageSampler.lazy_from_ipc_handle(old)
+    assert s3.layout == "pair" and s3.shuffle == "sort"
+    out = s2.sample(np.arange(8, dtype=np.int32))
+    assert out[1] == 8
