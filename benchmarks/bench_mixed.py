@@ -103,12 +103,17 @@ def main():
                                      device_mode="HBM",
                                      num_workers=args.workers, seed=0,
                                      **dev_kwargs)
-        # warmup epoch slice: compile + let the EMAs see both engines
-        warm = 0
-        for out in m:
-            warm += 1
-            if warm >= 2 * args.workers + 2:
-                break
+        # warmup on a short DEDICATED job, iterated to exhaustion:
+        # compile + let the EMAs see both engines. Breaking out of the
+        # real epoch's generator instead would abandon in-flight host
+        # futures that keep occupying workers into the timed run and
+        # leave the EMAs mid-epoch (r4 advisor finding).
+        warm_batches = 2 * args.workers + 2
+        m.job = PermutationJob(train_idx[:args.batch * warm_batches],
+                               args.batch, seed=2)
+        for _ in m:
+            pass
+        m.job = job
         t0 = time.perf_counter()
         edges = 0
         batches = 0

@@ -105,6 +105,19 @@ def from_numpy_dir(path: str, undirected: bool = False) -> GraphDataset:
     labels = np.asarray(data["labels"])
     if labels.ndim == 2 and labels.shape[1] == 1:
         labels = labels[:, 0]
+    # some exports mark unlabeled nodes with an integer -1 instead of
+    # NaN; -1 passes isfinite and would flow into the loss as a real
+    # class. Normalize negative sentinels to the NaN convention (loudly
+    # — the dtype widens to float) so num_classes and eval masks see
+    # them as unlabeled.
+    finite = np.isfinite(labels.astype(np.float64, copy=False))
+    if bool((labels[finite] < 0).any()):
+        from .debug import log as _log
+        neg = int((labels[finite] < 0).sum())
+        _log("labels contain %d negative entries; treating them as "
+             "unlabeled (NaN convention, papers100M-style)", neg)
+        labels = labels.astype(np.float32)
+        labels[labels < 0] = np.nan
     feat = np.ascontiguousarray(data["feat"])
     for key, rank in {**_REQUIRED, **_OPTIONAL}.items():
         if key in data and key != "labels" and np.asarray(data[key]).ndim != rank:

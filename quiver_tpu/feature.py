@@ -73,7 +73,8 @@ class Feature:
                  csr_topo: Optional[CSRTopo] = None,
                  mesh: Optional[Mesh] = None,
                  dtype=None,
-                 host_placement: str = "numpy"):
+                 host_placement: str = "numpy",
+                 cold_budget: Optional[int] = None):
         if cache_policy not in ("device_replicate", "p2p_clique_replicate",
                                 "shard"):
             raise ValueError(f"unknown cache_policy {cache_policy!r}")
@@ -93,6 +94,10 @@ class Feature:
         # semantics, quiver_feature.cu:174-293). Requires a backend with
         # usable host-offload (TPU/GPU; loud numpy fallback elsewhere).
         self.host_placement = host_placement
+        # static per-batch cap on how many rows the fused offload lookup
+        # reads from the host tier (None = max(batch//4, 256)); see
+        # _build_gather's lookup_tiered
+        self.cold_budget = cold_budget
         self.feature_order = None      # old id -> storage row
         self.cache_rows = 0
         self.device_part = None        # jnp [cache_rows, dim]
@@ -103,7 +108,9 @@ class Feature:
         self._gather_cached = None
         self._translate = None
         self._lookup_cached = None
+        self._lookup_cached_masked = None
         self._lookup_tiered = None
+        self._lookup_tiered_raw = None
         self._pool = None              # prefetch staging thread
 
     # -- sizing (reference feature.py:74-82) --------------------------------
@@ -151,8 +158,12 @@ class Feature:
         from .utils.placement import pinned_put
         dev = jax.devices()[self.rank if self.rank < len(jax.devices())
                             else 0]
+        # when a mesh is set the HBM cache is mesh-placed (sharded or
+        # mesh-replicated); the cold tier must share that device set or
+        # _lookup_tiered fails at dispatch — place it host-replicated
+        # over the same mesh
         got = pinned_put([self.host_part], dev, True,
-                         "the Feature host tier")
+                         "the Feature host tier", mesh=self.mesh)
         if got is not None:
             # the pinned array OWNS the cold tier — dropping the numpy
             # copy keeps host residency at 1x (pickling round-trips the
@@ -235,22 +246,74 @@ class Feature:
         # sits behind a network tunnel
         self._lookup_cached = jax.jit(lookup_cached)
 
+        def lookup_cached_masked(dev_part, ids, order):
+            # -1-mask semantics (masked ids -> zero rows) fused into
+            # the same single dispatch; the hetero frontier lookup's
+            # hot path
+            ids_i = ids.astype(jnp.int32)
+            safe = jnp.clip(ids_i, 0, max(cache_rows - 1, 0))
+            rows = gather_cached(dev_part, translate(safe, order))
+            return rows * (ids_i >= 0).astype(rows.dtype)[:, None]
+
+        self._lookup_cached_masked = jax.jit(lookup_cached_masked)
+
+        cold_budget = self.cold_budget
+
         def lookup_tiered(dev_part, host_part, ids, order):
             # one dispatch for the WHOLE tiered lookup: hot rows from
             # the HBM cache, cold rows gathered by XLA directly from
             # the (pinned host) cold tier — no Python round trip, no
             # data-dependent shapes. Semantics identical to the numpy
             # path (tested); placement makes it UVA-like on TPU/GPU.
+            #
+            # Host-memory traffic scales with the MISS RATE, not the
+            # batch: cold positions are compacted (rank + sort, the
+            # sample_layer_exact_wide hub-budget pattern) and only a
+            # static ``budget`` of host rows is gathered — the
+            # reference's UVA kernel likewise touches only the rows it
+            # needs (shard_tensor.cu.hpp:49-58). A batch whose cold
+            # count exceeds the budget falls back via ``lax.cond`` to
+            # the full-batch host gather — correct in every case, only
+            # the traffic bound degrades.
             t = translate(ids, order)
             hot = t < cache_rows
-            cold_n = host_part.shape[0]
-            cold = jnp.clip(t - cache_rows, 0, max(cold_n - 1, 0))
-            cold_rows = jnp.take(host_part, cold, axis=0)
+            n = t.shape[0]
+            cold_total = host_part.shape[0]
+            cold_idx = jnp.clip(t - cache_rows, 0, max(cold_total - 1, 0))
             if dev_part is None:
-                return cold_rows
+                return jnp.take(host_part, cold_idx, axis=0)
             hot_rows = gather_cached(dev_part, jnp.where(hot, t, 0))
-            return jnp.where(hot[:, None], hot_rows, cold_rows)
 
+            budget = (max(n // 4, 256) if cold_budget is None
+                      else cold_budget)
+            if budget >= n:
+                # budget can't beat a full gather: keep the single
+                # unconditional host read (also the tiny-batch path)
+                cold_rows = jnp.take(host_part, cold_idx, axis=0)
+                return jnp.where(hot[:, None], hot_rows, cold_rows)
+
+            cold = ~hot
+            n_cold = jnp.sum(cold).astype(jnp.int32)
+            iota = jnp.arange(n, dtype=jnp.int32)
+            crank = jnp.cumsum(cold).astype(jnp.int32) - 1
+            okey = jnp.where(cold & (crank < budget), crank,
+                             jnp.iinfo(jnp.int32).max)
+            _, cpos = jax.lax.sort((okey, iota), num_keys=1)
+            cpos = cpos[:budget]        # cold positions (garbage past n_cold)
+            c_valid = (jnp.arange(budget, dtype=jnp.int32)
+                       < jnp.minimum(n_cold, budget))
+            rows = jnp.take(host_part, cold_idx[cpos], axis=0)  # [budget, dim]
+            tgt = jnp.where(c_valid, cpos, n)                   # n = drop slot
+            narrow = hot_rows.at[tgt].set(rows, mode="drop")
+
+            def _full(_):
+                cold_rows = jnp.take(host_part, cold_idx, axis=0)
+                return jnp.where(hot[:, None], hot_rows, cold_rows)
+
+            return jax.lax.cond(n_cold > budget, _full,
+                                lambda _: narrow, None)
+
+        self._lookup_tiered_raw = lookup_tiered
         self._lookup_tiered = jax.jit(lookup_tiered)
 
     # -- lookup (reference feature.py:296-333) ------------------------------
@@ -300,6 +363,20 @@ class Feature:
         pos_p[:pos.size] = pos
         return out.at[jnp.asarray(pos_p)].set(jax.device_put(rows_p),
                                               mode="drop")
+
+    def getitem_masked(self, node_idx):
+        """``feature[clip(ids)]`` with -1-mask semantics: masked ids
+        produce zero rows. ONE dispatch on the pure-HBM path (the
+        hetero lookup's hot path over a tunnel); tiered paths compose
+        the mask around the tiered lookup."""
+        ids = jnp.asarray(node_idx)
+        if (self.host_part is None and self._host_offload is None
+                and self.mmap_array is None):
+            return self._lookup_cached_masked(self.device_part, ids,
+                                              self.feature_order)
+        safe = jnp.clip(ids, 0, self.size(0) - 1)
+        rows = self[safe]
+        return rows * (ids >= 0).astype(rows.dtype)[:, None]
 
     def prefetch(self, node_idx):
         """Start this lookup on a background thread and return a
@@ -368,7 +445,8 @@ class Feature:
     def __getstate__(self):
         state = {k: getattr(self, k) for k in self.__dict__
                  if k not in ("_gather_cached", "_translate",
-                              "_lookup_cached", "_lookup_tiered",
+                              "_lookup_cached", "_lookup_cached_masked",
+                              "_lookup_tiered", "_lookup_tiered_raw",
                               "_host_offload", "_pool")}
         # the pinned_host array doesn't pickle; round-trip its contents
         # through numpy and re-place on load
@@ -382,9 +460,13 @@ class Feature:
         self._gather_cached = None
         self._translate = None
         self._lookup_cached = None
+        self._lookup_cached_masked = None
         self._lookup_tiered = None
+        self._lookup_tiered_raw = None
         self._host_offload = None
         self._pool = None
+        # older pickles predate the knob
+        self.__dict__.setdefault("cold_budget", None)
         self._maybe_offload_host()
         self._build_gather()
 

@@ -491,19 +491,21 @@ class GraphSageSampler:
     def share_ipc(self):
         return (self.csr_topo, self.device, self.mode, self.sizes,
                 self.edge_weight, self.sampling, self.with_eid,
-                self.layout, self.shuffle)
+                self.layout, self.shuffle, self.wide_exact,
+                self.allow_fallback)
 
     @classmethod
     def lazy_from_ipc_handle(cls, ipc_handle):
-        # older 7-tuple handles (no layout/shuffle) still load and get
-        # the ctor defaults, like the Mixed sampler's handle[:6] pattern
+        # older short handles (7-tuple: no layout/shuffle; 9-tuple: no
+        # wide_exact/allow_fallback) still load and get the ctor
+        # defaults, like the Mixed sampler's handle[:6] pattern
         (csr_topo, device, mode, sizes, edge_weight, sampling,
          with_eid) = ipc_handle[:7]
         extras = {}
-        if len(ipc_handle) > 7:
-            extras["layout"] = ipc_handle[7]
-        if len(ipc_handle) > 8:
-            extras["shuffle"] = ipc_handle[8]
+        for pos, name in ((7, "layout"), (8, "shuffle"),
+                          (9, "wide_exact"), (10, "allow_fallback")):
+            if len(ipc_handle) > pos:
+                extras[name] = ipc_handle[pos]
         return cls(csr_topo, sizes, device=device, mode=mode,
                    edge_weight=edge_weight, sampling=sampling,
                    with_eid=with_eid, **extras)

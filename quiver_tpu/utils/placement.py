@@ -14,10 +14,16 @@ import jax
 from ..debug import log as _log
 
 
-def pinned_put(arrays, dev, allow_fallback, what):
-    """Place ``arrays`` on ``dev``'s pinned host memory. Returns the
-    placed list, or None after a LOUD log when ``allow_fallback`` and
-    the placement is unusable; raises otherwise.
+def pinned_put(arrays, dev, allow_fallback, what, mesh=None):
+    """Place ``arrays`` on pinned host memory. Returns the placed list,
+    or None after a LOUD log when ``allow_fallback`` and the placement
+    is unusable; raises otherwise.
+
+    With ``mesh`` the arrays are placed host-replicated over the mesh
+    (``NamedSharding(mesh, P(), memory_kind='pinned_host')``) so they
+    can feed computations whose other operands are mesh-sharded —
+    single-device pinned arrays and mesh-sharded arrays have
+    incompatible device sets and fail at dispatch.
 
     The CPU backend is explicitly gated out: it ACCEPTS the
     ``pinned_host`` placement and then fails at compile time on any
@@ -26,12 +32,19 @@ def pinned_put(arrays, dev, allow_fallback, what):
     pass through (the TPU side is probed on chip by
     benchmarks/host_mode_probe.py)."""
     try:
-        if getattr(dev, "platform", None) == "cpu":
+        platform = (mesh.devices.flat[0].platform if mesh is not None
+                    else getattr(dev, "platform", None))
+        if platform == "cpu":
             raise NotImplementedError(
                 "the CPU backend accepts pinned_host placement and then "
                 "fails compiling mixed-memory-space ops")
-        sh = jax.sharding.SingleDeviceSharding(
-            dev, memory_kind="pinned_host")
+        if mesh is not None:
+            sh = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(),
+                memory_kind="pinned_host")
+        else:
+            sh = jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="pinned_host")
         return [jax.device_put(a, sh) for a in arrays]
     except (ValueError, NotImplementedError) as e:
         if not allow_fallback:

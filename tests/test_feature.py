@@ -464,6 +464,74 @@ class TestOffloadHostTier:
             None, jnp.asarray(f.host_part), ids, f.feature_order))
         np.testing.assert_allclose(got, want, rtol=1e-6)
 
+    def test_budgeted_lookup_matches_numpy_path(self):
+        """Cold-row compaction (cold_budget < batch) is semantics-
+        neutral: under-budget batches take the narrow path, over-budget
+        batches the lax.cond fallback — both must equal the numpy host
+        path."""
+        rng = np.random.default_rng(3)
+        n, dim = 200, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=100 * dim * 4, cold_budget=8)
+        f.from_cpu_tensor(feat)
+        assert f.cache_rows == 100
+        host = jnp.asarray(f.host_part)
+        for cold_count in (0, 3, 8, 9, 20):   # spans the budget boundary
+            ids = np.concatenate([
+                rng.integers(0, 100, size=32 - cold_count),
+                rng.integers(100, n, size=cold_count)])
+            rng.shuffle(ids)
+            ids = jnp.asarray(ids)
+            want = np.asarray(f[ids])
+            got = np.asarray(f._lookup_tiered(
+                f.device_part, host, ids, f.feature_order))
+            np.testing.assert_allclose(got, want, rtol=1e-6,
+                                       err_msg=f"cold_count={cold_count}")
+
+    def test_budgeted_lookup_host_read_is_budget_sized(self):
+        """The narrow path's ONLY read of the host tier is a
+        budget-sized gather; the full batch-sized host gather exists
+        only inside the lax.cond fallback branch. Asserted on the
+        traced jaxpr so the traffic bound can't silently regress."""
+        import jax as _jax
+        rng = np.random.default_rng(4)
+        n, dim, batch, budget = 200, 8, 64, 8
+        # cache 80 / host 120 rows: tier shapes must DIFFER so the
+        # jaxpr walk can tell host reads from cache reads
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=80 * dim * 4,
+                       cold_budget=budget)
+        f.from_cpu_tensor(feat)
+        assert f.host_part.shape[0] == 120
+        host = jnp.asarray(f.host_part)
+        ids = jnp.asarray(rng.integers(0, n, size=batch))
+        jaxpr = _jax.make_jaxpr(f._lookup_tiered_raw)(
+            f.device_part, host, ids, f.feature_order)
+        host_shape = tuple(host.shape)
+
+        def host_gathers(jxp, inside_cond):
+            out = []
+            for eqn in jxp.eqns:
+                if eqn.primitive.name == "cond":
+                    for br in eqn.params["branches"]:
+                        out += host_gathers(br.jaxpr, True)
+                elif eqn.primitive.name == "gather":
+                    src = eqn.invars[0].aval.shape
+                    if tuple(src) == host_shape:
+                        out.append((eqn.outvars[0].aval.shape[0],
+                                    inside_cond))
+                else:
+                    for sub in eqn.params.values():
+                        if hasattr(sub, "jaxpr"):   # pjit / closed calls
+                            out += host_gathers(sub.jaxpr, inside_cond)
+            return out
+
+        reads = host_gathers(jaxpr.jaxpr, False)
+        narrow = [r for r, in_cond in reads if not in_cond]
+        fallback = [r for r, in_cond in reads if in_cond]
+        assert narrow == [budget], reads      # bounded by the budget
+        assert batch in fallback, reads       # full gather only in cond
+
     def test_offload_on_cpu_falls_back_loudly(self, caplog):
         import logging
         rng = np.random.default_rng(0)

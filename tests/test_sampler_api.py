@@ -568,3 +568,20 @@ def test_ipc_handle_carries_layout_and_shuffle(small_graph):
     assert s3.layout == "pair" and s3.shuffle == "sort"
     out = s2.sample(np.arange(8, dtype=np.int32))
     assert out[1] == 8
+
+
+def test_ipc_handle_carries_wide_exact_and_fallback(small_graph):
+    """r5 (ADVICE r4): wide_exact/allow_fallback ride the IPC tuple at
+    positions 9/10 — a rebuilt sampler must not silently reinstate the
+    wide-exact index copies or lose fallback strictness. Old 9-tuples
+    still load with ctor defaults."""
+    import quiver_tpu as qv
+    indptr, indices = small_graph
+    topo = qv.CSRTopo(indptr=indptr, indices=indices)
+    s = qv.GraphSageSampler(topo, [4, 2], sampling="exact",
+                            wide_exact=False, allow_fallback=False)
+    s2 = qv.GraphSageSampler.lazy_from_ipc_handle(s.share_ipc())
+    assert s2.wide_exact is False and s2.allow_fallback is False
+    # back-compat: a 9-tuple (pre-r5) gets ctor defaults
+    s3 = qv.GraphSageSampler.lazy_from_ipc_handle(s.share_ipc()[:9])
+    assert s3.wide_exact is True and s3.allow_fallback is True
