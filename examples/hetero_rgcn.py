@@ -43,7 +43,7 @@ def main():
     import jax.numpy as jnp
     import optax
     import quiver_tpu as qv
-    from quiver_tpu import HeteroCSRTopo, HeteroGraphSageSampler
+    from quiver_tpu import HeteroCSRTopo, HeteroFeature, HeteroGraphSageSampler
     from quiver_tpu.models import RGCN
 
     rng = np.random.default_rng(0)
@@ -74,15 +74,24 @@ def main():
     tx = optax.adam(3e-3)
     bs = args.batch
 
+    # typed tiered stores (MAG240M-shaped placement): the big paper
+    # matrix gets a small degree-ordered HBM cache + host tier, the
+    # small author/institution matrices sit fully in HBM — the same
+    # Feature machinery (policies, host/disk tiers, prefetch) per type
+    row_bytes = args.dim * 4
+    hfeat = HeteroFeature.from_cpu_tensors(
+        feats,
+        configs={
+            "paper": dict(
+                device_cache_size=(args.papers // 4) * row_bytes,
+                csr_topo=topo.rels[("paper", "cites", "paper")]),
+            "author": dict(device_cache_size=args.authors * row_bytes),
+            "institution": dict(
+                device_cache_size=args.institutions * row_bytes),
+        })
+
     def gather(frontier):
-        x = {}
-        for t, f in frontier.items():
-            if f is None:
-                continue
-            ids = jnp.clip(f, 0, counts[t] - 1)
-            x[t] = jnp.asarray(feats[t])[ids] * \
-                (f >= 0).astype(jnp.float32)[:, None]
-        return x
+        return hfeat.lookup(frontier)
 
     seeds = rng.choice(args.papers, bs, replace=False)
     _, _, layers = sampler.sample(seeds)

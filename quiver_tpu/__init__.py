@@ -33,6 +33,7 @@ from .partition import (
     partition_feature_without_replication,
 )
 from .hetero import HeteroCSRTopo, HeteroGraphSageSampler
+from .hetero_feature import HeteroFeature
 from .async_sampler import AsyncNeighborSampler, AsyncCudaNeighborSampler
 from .debug import show_tensor_info
 from .inference import layerwise_inference
@@ -72,6 +73,7 @@ __all__ = [
     "load_quiver_feature_partition",
     "partition_feature_without_replication",
     "HeteroCSRTopo",
+    "HeteroFeature",
     "HeteroGraphSageSampler",
     "AsyncNeighborSampler",
     "AsyncCudaNeighborSampler",

@@ -23,11 +23,13 @@ from typing import Dict, List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .ops.sample import (as_index_rows, as_index_rows_overlapping,
                          compact_union, edge_row_ids, reshuffle_csr,
                          sample_layer, sample_layer_exact_wide,
                          sample_layer_rotation, sample_layer_window)
+from .ops.weighted import sample_layer_weighted
 from .pyg.sage_sampler import Adj
 from .utils import CSRTopo
 
@@ -101,12 +103,23 @@ class HeteroGraphSageSampler:
     frontier caps multiplicatively per hop. Sampled edges whose source
     falls past the cap are masked (-1) — the same static-capacity
     truncation contract as every other capped shape here.
+
+    ``edge_weight`` (``{edge_type: CSR-slot-aligned weights}``) switches
+    the listed relations to weighted (attention) draws — with
+    replacement, proportional to weight, the reference ``weight_sample``
+    contract (cuda_random.cu.hpp:178-221); unlisted relations keep the
+    uniform exact draw. ``with_eid=True`` stamps every sampled edge's
+    ``Adj.e_id`` with its global edge id (the relation's
+    ``CSRTopo.eid`` if set, else its CSR slot), -1 where masked. Both
+    are exact-mode only (see the ctor guards).
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
                  seed_type: str, seed: int = 0, sampling: str = "exact",
                  layout: str = "pair", shuffle: str = "sort",
-                 frontier_cap=None, wide_exact: bool = True):
+                 frontier_cap=None, wide_exact: bool = True,
+                 edge_weight: Dict[EdgeType, object] = None,
+                 with_eid: bool = False):
         self.topo = topo
         self.seed_type = seed_type
         self.sizes = [s if isinstance(s, dict)
@@ -131,6 +144,47 @@ class HeteroGraphSageSampler:
         # wide_exact=False: skip the per-relation layout views (+E/+2E
         # memory each) and keep the zero-extra-copy scattered exact draw
         self.wide_exact = wide_exact
+        # per-relation CSR-slot-aligned weights => weighted (attention)
+        # draws for those relations (with replacement, the reference
+        # weight_sample contract — cuda_random.cu.hpp:178-221);
+        # unlisted relations keep the uniform exact draw. Same coupled-
+        # param strictness as the homogeneous ctor: the weighted
+        # windowed draw's mandatory hub re-placement and the co-
+        # permuted slot maps only exist on the homogeneous
+        # rotation/window path, so weighted/eid hetero sampling is
+        # exact-mode only — an explicit error, not a silent downgrade.
+        if edge_weight is not None:
+            unknown = set(edge_weight) - set(topo.rels)
+            if unknown:
+                raise ValueError(
+                    f"edge_weight for unknown relation(s) "
+                    f"{sorted(unknown)}")
+            if sampling != "exact":
+                raise ValueError(
+                    "per-relation weighted draws support "
+                    "sampling='exact' only (rotation/window would need "
+                    "the weighted windowed draw's co-permuted weight "
+                    "rows — use the homogeneous GraphSageSampler for "
+                    "that workload)")
+            for et, w in edge_weight.items():
+                e = int(topo.rels[et].indices.shape[0])
+                # np.shape: no device transfer for the length check
+                # (jnp.asarray would ship each E-sized array to HBM
+                # just to read its shape)
+                if int(np.shape(w)[0]) != e:
+                    raise ValueError(
+                        f"edge_weight[{et}] has {int(np.shape(w)[0])} "
+                        f"entries, relation has {e} edges")
+        if with_eid and sampling != "exact":
+            raise ValueError(
+                "with_eid supports sampling='exact' only for hetero "
+                "graphs (rotation/window slots live in per-epoch "
+                "permuted coordinates; the co-permuted slot map is a "
+                "homogeneous-sampler feature)")
+        self.edge_weight = edge_weight
+        self.with_eid = with_eid
+        self._weights_placed = None
+        self._eids_placed = None
         self._key = jax.random.key(seed)
         self._fn_cache = {}
         self._rows = None        # {edge_type: rows view}
@@ -181,12 +235,13 @@ class HeteroGraphSageSampler:
         method = self.sampling
         stride = self._stride
         caps = self.frontier_cap
+        with_eid = self.with_eid
 
         # rels/rows enter as jit ARGUMENTS (pytrees), never closures: a
         # closed-over device array is embedded in the HLO as a literal
         # constant, and MAG240M-scale relations would overflow a remote
         # (tunnel) compile request — same hazard bench.py documents
-        def run(seeds, key, rows, rels):
+        def run(seeds, key, rows, rels, weights, eids):
             frontier = {t: None for t in node_types}
             frontier[seed_type] = seeds.astype(jnp.int32)
             hops = []
@@ -202,32 +257,53 @@ class HeteroGraphSageSampler:
                     sub = jax.random.fold_in(key, step)
                     step += 1
                     indptr, indices = rels[et]
-                    if method == "rotation":
+                    slots = None
+                    w = weights.get(et)
+                    if w is not None:
+                        out = sample_layer_weighted(
+                            indptr, indices, w, cur, k, sub,
+                            with_slots=with_eid)
+                        (nbrs, _, slots) = out if with_eid else \
+                            (out[0], out[1], None)
+                    elif method == "rotation":
                         nbrs, _ = sample_layer_rotation(
                             indptr, rows[et], cur, k, sub, stride=stride)
                     elif method == "window":
                         nbrs, _ = sample_layer_window(
                             indptr, rows[et], cur, k, sub, stride=stride)
                     elif rows is not None:
-                        nbrs, _ = sample_layer_exact_wide(
+                        out = sample_layer_exact_wide(
                             indptr, indices, rows[et], cur, k, sub,
-                            stride=stride)
+                            stride=stride, with_slots=with_eid)
+                        (nbrs, _, slots) = out if with_eid else \
+                            (out[0], out[1], None)
                     else:
-                        nbrs, _ = sample_layer(indptr, indices, cur, k, sub)
-                    per_rel_samples[et] = (cur, nbrs)
+                        out = sample_layer(indptr, indices, cur, k, sub,
+                                           with_slots=with_eid)
+                        (nbrs, _, slots) = out if with_eid else \
+                            (out[0], out[1], None)
+                    if slots is not None and et in eids:
+                        # CSR slot -> original COO edge id (CSRTopo.eid)
+                        e = eids[et]
+                        slots = jnp.where(
+                            slots >= 0,
+                            e[jnp.clip(slots, 0, e.shape[0] - 1)]
+                            .astype(slots.dtype), -1)
+                    per_rel_samples[et] = (cur, nbrs, slots)
                 # 2. per src type: compact (old frontier ++ all sampled)
                 new_frontier = dict(frontier)
                 new_counts = {}
                 adjs = {}
                 by_src: Dict[str, list] = {}
-                for et, (cur, nbrs) in per_rel_samples.items():
-                    by_src.setdefault(et[0], []).append((et, cur, nbrs))
+                for et, (cur, nbrs, slots) in per_rel_samples.items():
+                    by_src.setdefault(et[0], []).append(
+                        (et, cur, nbrs, slots))
                 for src_t, group in by_src.items():
                     prev = frontier[src_t]
                     prev = prev if prev is not None else \
                         jnp.full((0,), -1, jnp.int32)
                     all_nbrs = jnp.concatenate(
-                        [nbrs.reshape(-1) for _, _, nbrs in group])
+                        [nbrs.reshape(-1) for _, _, nbrs, _ in group])
                     n_id, n_count, extra_local = compact_union(prev, all_nbrs)
                     cap = caps.get(src_t) if caps else None
                     if cap is not None and n_id.shape[0] > cap:
@@ -243,7 +319,7 @@ class HeteroGraphSageSampler:
                     new_counts[src_t] = n_count
                     # 3. per relation: local COO against the merged frontier
                     offset = 0
-                    for et, cur, nbrs in group:
+                    for et, cur, nbrs, slots in group:
                         s, kk = nbrs.shape
                         flat = extra_local[offset:offset + s * kk]
                         offset += s * kk
@@ -252,8 +328,14 @@ class HeteroGraphSageSampler:
                             jnp.repeat(jnp.arange(s, dtype=jnp.int32), kk),
                             -1)
                         edge_index = jnp.stack([flat, row])
+                        e_id = None
+                        if slots is not None:
+                            # frontier-cap truncation masks the edge in
+                            # flat; its e_id masks with it
+                            e_id = jnp.where(flat >= 0,
+                                             slots.reshape(-1), -1)
                         adjs[et] = Adj(
-                            edge_index=edge_index, e_id=None,
+                            edge_index=edge_index, e_id=e_id,
                             size=(int(n_id.shape[0]), s),
                             mask=flat >= 0)
                 hops.append((adjs, dict(new_frontier), new_counts))
@@ -277,18 +359,31 @@ class HeteroGraphSageSampler:
             elif self.wide_exact:
                 # exact: static layout views of the un-shuffled indices
                 # route every relation through the wide-fetch exact path
+                # (weighted relations draw from the pool CDF instead —
+                # no view, no +E copy for them)
                 self._rows = {et: self._as_rows(jnp.asarray(t.indices))
-                              for et, t in self.topo.rels.items()}
+                              for et, t in self.topo.rels.items()
+                              if not (self.edge_weight
+                                      and et in self.edge_weight)}
         if self._rels_placed is None:
             self._rels_placed = {
                 et: (jnp.asarray(t.indptr), jnp.asarray(t.indices))
                 for et, t in self.topo.rels.items()}
+        if self.edge_weight is not None and self._weights_placed is None:
+            self._weights_placed = {et: jnp.asarray(w)
+                                    for et, w in self.edge_weight.items()}
+        if self.with_eid and self._eids_placed is None:
+            self._eids_placed = {
+                et: jnp.asarray(t.eid)
+                for et, t in self.topo.rels.items() if t.eid is not None}
         fn = self._fn_cache.get(bs)
         if fn is None:
             fn = self._build(bs)
             self._fn_cache[bs] = fn
         frontier, hops = fn(seeds, self.next_key(), self._rows,
-                            self._rels_placed)
+                            self._rels_placed,
+                            self._weights_placed or {},
+                            self._eids_placed or {})
         layers = [HeteroLayer(adjs=a, frontier=f, counts=c)
                   for a, f, c in hops]
         return frontier, bs, layers[::-1]
