@@ -1,23 +1,44 @@
 # Shared helper for the on-chip suite scripts. Source from a script
 # that has set LOG (the append-target) — and optionally T (per-step
-# timeout seconds, default 1800).
+# timeout seconds, default 1800) and STEP_GAP (seconds to sleep after
+# each step, default 20 — lets the axon device claim release before
+# the next process asks for it).
 #
 # NEVER kill a step mid-claim — a killed TPU process can wedge the
 # device claim for ~30+ minutes; the per-step timeout is the only
 # reaper.
 T=${T:-1800}
+STEP_GAP=${STEP_GAP:-20}
 
 # pipeline status would be tee's, not the command's (POSIX sh has no
 # PIPESTATUS) — capture the real rc via a temp file so a crash or a
 # timeout is loudly marked in the log instead of reading as a silently
-# truncated success
+# truncated success. grep runs --line-buffered so the log shows live
+# progress (r5: a 30-min stall was invisible behind grep's 4KB block
+# buffer).
 step() {
     echo "=== $* ===" | tee -a "$LOG"
     rcfile=$(mktemp)
     { timeout "$T" "$@" 2>&1; echo $? > "$rcfile"; } \
-        | grep -v "WARNING" | tee -a "$LOG"
+        | grep --line-buffered -v "WARNING" | tee -a "$LOG"
     rc=$(cat "$rcfile"); rm -f "$rcfile"
     if [ "$rc" != "0" ]; then
         echo "=== FAILED rc=$rc (124=timeout): $* ===" | tee -a "$LOG"
     fi
+    sleep "$STEP_GAP"
+}
+
+# Bounded usability probe (benchmarks/canary.py): jax.devices()
+# answering does NOT mean the device is usable — gate a suite on this
+# before burning per-step timeouts on a wedged claim. Returns canary's
+# rc; the JSON line lands in the log either way.
+canary() {
+    echo "=== canary ===" | tee -a "$LOG"
+    rcfile=$(mktemp)
+    { timeout 180 python -u benchmarks/canary.py 150 2>&1; \
+      echo $? > "$rcfile"; } \
+        | grep --line-buffered -v "WARNING" | tee -a "$LOG"
+    rc=$(cat "$rcfile"); rm -f "$rcfile"
+    sleep "$STEP_GAP"
+    return "$rc"
 }

@@ -1,0 +1,61 @@
+#!/bin/sh
+# One-command round-start arming of the evidence pipeline. Run this at
+# the START of every round (nohup sh benchmarks/arm_watch.sh &) and the
+# recover -> run -> transcribe -> commit loop needs zero human steps:
+#
+#   1. probe the TPU backend every PROBE_SLEEP seconds (default 390 —
+#      off the :00/:30 marks) until it answers;
+#   2. on recovery, run the suite scripts given as arguments (default:
+#      chip_suite4.sh chip_suite5.sh);
+#   3. transcribe the suite log's result lines into $OUT_MD
+#      (default docs/measurements_auto.md) with a RECOVERED marker;
+#   4. git-commit the log + transcription so the evidence survives the
+#      round boundary even if nobody reads it.
+#
+# If the chip is ALREADY up, the suites start immediately — so arming
+# is safe (and right) to do unconditionally at round start. The probe
+# gives up after MAX_PROBES (default 110 ~= 12 h at 390 s) so a stale
+# watcher doesn't outlive its round by much; re-arm each round.
+cd "$(dirname "$0")/.."
+LOG=benchmarks/chip_watch_auto.log
+OUT_MD=${OUT_MD:-docs/measurements_auto.md}
+PROBE_SLEEP=${PROBE_SLEEP:-390}
+MAX_PROBES=${MAX_PROBES:-110}
+SUITES=${*:-"benchmarks/chip_suite4.sh benchmarks/chip_suite5.sh"}
+
+# usability probe, not a presence probe: jax.devices() can answer while
+# the device claim is wedged (r5 lesson) — canary.py times a real
+# bounded round trip
+probe() {
+    timeout 180 python benchmarks/canary.py 150 >/dev/null 2>&1
+}
+
+echo "$(date) armed: suites=[$SUITES] out=$OUT_MD" | tee -a "$LOG"
+i=0
+until probe; do
+    i=$((i + 1))
+    echo "$(date) probe $i/$MAX_PROBES: backend still down" >> "$LOG"
+    if [ "$i" -ge "$MAX_PROBES" ]; then
+        echo "$(date) giving up after $i probes (re-arm next round)" \
+            | tee -a "$LOG"
+        exit 1
+    fi
+    sleep "$PROBE_SLEEP"
+done
+echo "$(date) RECOVERED after $i down-probes; running suites" \
+    | tee -a "$LOG"
+
+for s in $SUITES; do
+    sh "$s" >> "$LOG" 2>&1
+done
+
+python benchmarks/transcribe_log.py --out "$OUT_MD" \
+    --marker "RECOVERED (armed watcher)" >> "$LOG" 2>&1
+
+# -f: *.log is gitignored; the whole point here is committing the raw
+# evidence anyway
+git add -f benchmarks/chip_suite.log "$LOG" 2>> "$LOG"
+git add "$OUT_MD" 2>> "$LOG"
+git commit -m "Auto-transcribed on-chip suite results (armed watcher)" \
+    >> "$LOG" 2>&1 || echo "$(date) nothing to commit" >> "$LOG"
+echo "$(date) evidence pipeline complete" | tee -a "$LOG"

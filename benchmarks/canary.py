@@ -1,0 +1,71 @@
+"""60-second TPU canary: is the backend USABLE, not just present?
+
+Times each stage of the smallest possible device round trip (backend
+init, tiny H2D, tiny compile, execute, D2H, then a 16 MB transfer to
+estimate tunnel bandwidth) with a hard alarm so a wedged claim can't
+hang the caller. Exit 0 = usable; prints one JSON line either way.
+
+The r5 lesson behind it: `jax.devices()` answering does NOT mean the
+device is usable — bench.py once sat 30 min in a socket read with the
+platform "up". Run this before committing to a long suite.
+"""
+
+import json
+import signal
+import sys
+import time
+
+STAGES = {}
+_t0 = time.perf_counter()
+
+
+def _die(signum, frame):
+    print(json.dumps({"usable": False, "stages": STAGES,
+                      "error": "alarm: stage hung"}), flush=True)
+    sys.exit(3)
+
+
+signal.signal(signal.SIGALRM, _die)
+signal.alarm(int(sys.argv[1]) if len(sys.argv) > 1 else 120)
+
+
+def stage(name):
+    STAGES[name] = round(time.perf_counter() - _t0, 3)
+
+
+try:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    backend = jax.default_backend()
+    stage("backend_init")
+    if backend == "cpu":
+        print(json.dumps({"usable": False, "stages": STAGES,
+                          "error": "cpu fallback"}), flush=True)
+        sys.exit(2)
+    x = jax.device_put(np.arange(1024, dtype=np.float32))
+    x.block_until_ready()
+    stage("h2d_small")
+    y = jax.jit(lambda a: (a * 2).sum())(x)
+    y.block_until_ready()
+    stage("compile_exec")
+    float(y)
+    stage("d2h")
+    big = jax.device_put(np.zeros((4 * 1024 * 1024,), dtype=np.float32))
+    big.block_until_ready()
+    t = time.perf_counter()
+    # fresh buffer so the transfer isn't elided
+    big2 = jax.device_put(np.ones((4 * 1024 * 1024,), dtype=np.float32))
+    big2.block_until_ready()
+    bw = 16.0 / max(time.perf_counter() - t, 1e-9)
+    stage("h2d_16mb")
+    signal.alarm(0)
+    print(json.dumps({"usable": True, "backend": backend,
+                      "stages": STAGES,
+                      "h2d_MBps": round(bw, 1)}), flush=True)
+except Exception as e:  # noqa: BLE001 - report any failure as unusable
+    signal.alarm(0)
+    print(json.dumps({"usable": False, "stages": STAGES,
+                      "error": repr(e)[:300]}), flush=True)
+    sys.exit(1)

@@ -9,6 +9,11 @@ LOG=benchmarks/chip_suite.log
 
 date | tee -a "$LOG"
 
+if ! canary; then
+    echo "canary: device unusable; aborting suite (re-arm via benchmarks/arm_watch.sh)" | tee -a "$LOG"
+    exit 1
+fi
+
 # 1. metric of record: the full default sweep (pair/sort, overlap/sort,
 #    overlap/butterfly; best wins, labeled) + FY window + exact sides
 step python -u bench.py

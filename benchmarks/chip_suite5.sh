@@ -10,6 +10,11 @@ LOG=benchmarks/chip_suite.log
 
 date | tee -a "$LOG"
 
+if ! canary; then
+    echo "canary: device unusable; aborting suite (re-arm via benchmarks/arm_watch.sh)" | tee -a "$LOG"
+    exit 1
+fi
+
 # 1. exact-mode head-to-head: scattered vs wide-fetch (same i.i.d. draw)
 step python -u benchmarks/bench_sampler.py --hop1 exact
 step python -u benchmarks/bench_sampler.py --hop1 wide

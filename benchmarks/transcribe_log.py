@@ -1,0 +1,92 @@
+"""Transcribe benchmarks/chip_suite.log into a measurements record.
+
+The evidence pipeline (recover -> run suites -> transcribe -> commit)
+previously had a human in the middle: someone had to read the raw suite
+log and write docs/measurements_r*.md by hand, and rounds 3/4 proved
+the human may not be there when the chip comes back. This script is the
+machine half: it walks the suite log's ``=== cmd ===`` step structure
+and appends a markdown section with every step's result lines (bench
+JSON lines, SEPS/GB/s/epoch summaries, FAILED markers) to the given
+measurements file.
+
+Usage: python benchmarks/transcribe_log.py [--log PATH] [--out PATH]
+                                           [--marker TEXT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import re
+import sys
+
+RESULT_PAT = re.compile(
+    r"^\{\"|SEPS|GB/s|edges/s|epoch|acc|vs_baseline|FAILED rc=|"
+    r"split|quota|winner|pinned_host|probe", re.IGNORECASE)
+
+
+def parse_steps(text: str):
+    """Yield (command, result_lines) per ``=== cmd ===`` block."""
+    cmd = None
+    lines: list[str] = []
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        m = re.match(r"^=== (?!FAILED)(.+) ===$", line)
+        if m:
+            if cmd is not None:
+                yield cmd, lines
+            cmd, lines = m.group(1), []
+            continue
+        if cmd is None:
+            continue
+        if re.match(r"^=== FAILED (.+) ===$", line):
+            lines.append(line.strip("= ").strip())
+            continue
+        if RESULT_PAT.search(line):
+            lines.append(line)
+    if cmd is not None:
+        yield cmd, lines
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--log", default="benchmarks/chip_suite.log")
+    p.add_argument("--out", default=None,
+                   help="measurements file to append to (default: "
+                        "docs/measurements_auto.md)")
+    p.add_argument("--marker", default="RECOVERED",
+                   help="marker word for the section header")
+    args = p.parse_args(argv)
+    if not os.path.exists(args.log):
+        print(f"no log at {args.log}; nothing to transcribe",
+              file=sys.stderr)
+        return 1
+    out = args.out or "docs/measurements_auto.md"
+    text = open(args.log).read()
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%d %H:%M UTC")
+    chunks = [f"\n## {args.marker}: auto-transcribed suite results "
+              f"({stamp})\n"]
+    n_steps = n_fail = 0
+    for cmd, lines in parse_steps(text):
+        n_steps += 1
+        chunks.append(f"\n### `{cmd}`\n")
+        if not lines:
+            chunks.append("(no result lines captured)\n")
+            continue
+        for line in lines:
+            if line.startswith("FAILED"):
+                n_fail += 1
+            chunks.append(f"    {line}\n")
+    chunks.append(f"\n{n_steps} steps transcribed, {n_fail} failed "
+                  f"(see {args.log} for full output).\n")
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "a") as f:
+        f.writelines(chunks)
+    print(f"transcribed {n_steps} steps -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
