@@ -423,9 +423,17 @@ class Feature:
     # -- shape protocol ------------------------------------------------------
     @property
     def shape(self):
-        cold = (self.host_part if self.host_part is not None
-                else self._host_offload)
-        rows = self.cache_rows + (0 if cold is None else cold.shape[0])
+        if self.disk_map is not None:
+            # disk tier active: disk_map spans the FULL logical id
+            # space (it is indexed by storage row in _read_cold), so it
+            # IS the row count — cache+host alone would under-report
+            # (reference feature.py:335-354 likewise reports the full
+            # logical space)
+            rows = int(self.disk_map.shape[0])
+        else:
+            cold = (self.host_part if self.host_part is not None
+                    else self._host_offload)
+            rows = self.cache_rows + (0 if cold is None else cold.shape[0])
         dim = None
         if self.device_part is not None:
             dim = self.device_part.shape[1]
@@ -433,6 +441,8 @@ class Feature:
             dim = self.host_part.shape[1]
         elif self._host_offload is not None:
             dim = self._host_offload.shape[1]
+        elif self.mmap_array is not None:
+            dim = self.mmap_array.shape[1]
         return (rows, dim)
 
     def size(self, dim: int) -> int:
@@ -550,6 +560,11 @@ class DistFeature:
       identical on a virtual CPU mesh, a TPU slice, or multi-slice DCN.
     - **local/peers** (a ``Feature`` + optional in-process peer registry):
       host-driven dispatch for single-process tests of the protocol.
+      NOT a production path: every lookup round-trips the ids through
+      numpy (``device_get`` + per-host ``flatnonzero``) and gathers
+      per host on the Python side — fine for protocol tests and demos,
+      ~unusable at training batch rates. Use ``from_partition`` (the
+      one-jitted-program SPMD path) for real workloads.
     """
 
     def __init__(self, feature: Optional[Feature], info: PartitionInfo,

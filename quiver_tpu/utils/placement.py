@@ -13,6 +13,36 @@ import jax
 
 from ..debug import log as _log
 
+# platform -> bool; a capability PROBE, not a platform allowlist: the
+# failure mode being guarded (today's CPU backend ACCEPTS the
+# pinned_host placement and then fails compiling any op mixing host-
+# and default-space operands — placement succeeds, every later use
+# raises) is a property of the installed jax/backend pair, so it is
+# probed once per platform with a tiny mixed-space op instead of
+# hardcoding a platform string that would silently force the fallback
+# regime on a future jax where CPU host-offload works.
+_USABLE: dict = {}
+
+
+def _host_offload_usable(dev) -> bool:
+    key = getattr(dev, "platform", None)
+    got = _USABLE.get(key)
+    if got is None:
+        try:
+            import numpy as np
+            sh = jax.sharding.SingleDeviceSharding(
+                dev, memory_kind="pinned_host")
+            host = jax.device_put(np.ones((8,), np.float32), sh)
+            main = jax.device_put(np.ones((8,), np.float32), dev)
+            # the exact usage pattern the offload tiers need: one jitted
+            # computation over a host-space and a default-space operand
+            float(jax.jit(lambda h, m: (h + m).sum())(host, main))
+            got = True
+        except Exception:  # noqa: BLE001 - any failure means unusable
+            got = False
+        _USABLE[key] = got
+    return got
+
 
 def pinned_put(arrays, dev, allow_fallback, what, mesh=None):
     """Place ``arrays`` on pinned host memory. Returns the placed list,
@@ -25,19 +55,15 @@ def pinned_put(arrays, dev, allow_fallback, what, mesh=None):
     single-device pinned arrays and mesh-sharded arrays have
     incompatible device sets and fail at dispatch.
 
-    The CPU backend is explicitly gated out: it ACCEPTS the
-    ``pinned_host`` placement and then fails at compile time on any
-    computation mixing host- and default-space operands — the worst of
-    both: placement succeeds, every later use raises. TPU/GPU backends
-    pass through (the TPU side is probed on chip by
-    benchmarks/host_mode_probe.py)."""
+    Usability is established by ``_host_offload_usable``'s probe (one
+    tiny mixed-memory-space op per platform, cached); the TPU side is
+    additionally measured on chip by benchmarks/host_mode_probe.py."""
     try:
-        platform = (mesh.devices.flat[0].platform if mesh is not None
-                    else getattr(dev, "platform", None))
-        if platform == "cpu":
+        probe_dev = mesh.devices.flat[0] if mesh is not None else dev
+        if not _host_offload_usable(probe_dev):
             raise NotImplementedError(
-                "the CPU backend accepts pinned_host placement and then "
-                "fails compiling mixed-memory-space ops")
+                "this backend accepts pinned_host placement but cannot "
+                "compile mixed-memory-space ops (probed)")
         if mesh is not None:
             sh = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(),
