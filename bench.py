@@ -36,9 +36,15 @@ import time
 
 BASELINE_SEPS = 34.29e6   # reference Quiver UVA, 1 GPU, products [15,10,5]
 
+# a USABILITY probe, not a presence probe: the round-5 outage pattern
+# was jax.devices() answering while the first real dispatch blocked
+# forever in a socket read — so the probe must round-trip a tiny
+# compile+execute+D2H, the smallest thing the bench itself will do
 PROBE_SNIPPET = (
-    "import jax, sys; d = jax.devices(); "
-    "print(d[0].platform); sys.stdout.flush()"
+    "import jax, numpy as np, sys; d = jax.devices(); "
+    "x = jax.device_put(np.ones((8,), np.float32)); "
+    "v = float(jax.jit(lambda a: (a * 2).sum())(x)); "
+    "assert v == 16.0, v; print(d[0].platform); sys.stdout.flush()"
 )
 
 
@@ -52,7 +58,7 @@ def _error_line(stderr):
     return lines[-1].strip() if lines else "unknown error"
 
 
-def probe_backend(platform="", timeout_s=55.0, retries=2):
+def probe_backend(platform="", timeout_s=120.0, retries=2):
     """Check the jax backend initializes, out-of-process.
 
     The axon/TPU init can hang (uninterruptibly) rather than raise, so the
@@ -85,6 +91,17 @@ def probe_backend(platform="", timeout_s=55.0, retries=2):
     return False, detail
 
 
+METRIC = ("sampled-edges/sec (ogbn-products-scale, "
+          "fanout [15,10,5], batch 1024)")
+
+
+def _fail(err, flush=False):
+    """The one JSON-line failure shape (shared by the probe-refusal
+    branch and the watchdog so the schema can't drift between them)."""
+    print(json.dumps({"metric": METRIC, "value": None, "unit": "edges/s",
+                      "vs_baseline": None, "error": err}), flush=flush)
+
+
 def main():
     platform = os.environ.get("QT_BENCH_PLATFORM", "")
     if "--platform" in sys.argv:
@@ -113,16 +130,30 @@ def main():
             err = (f"TPU backend unavailable: {detail}" if not ok else
                    "backend probe resolved to CPU, not TPU; refusing the "
                    "full-scale bench (use --platform cpu for smoke mode)")
-            print(json.dumps({
-                "metric": "sampled-edges/sec (ogbn-products-scale, "
-                          "fanout [15,10,5], batch 1024)",
-                "value": None,
-                "unit": "edges/s",
-                "vs_baseline": None,
-                "error": err,
-            }))
+            _fail(err)
             sys.exit(1)
         defaults = dict(nodes=2_450_000, deg=25, batches=192)
+        # even a usable-at-probe-time backend can hang mid-run (the
+        # tunnel died under bench.py once this round); guarantee the
+        # caller a JSON line rather than an open-ended hang. SIGALRM
+        # can't fire inside a blocked C call, so the watchdog is a
+        # daemon thread + os._exit. _bench_done gates it so a
+        # post-result teardown hang can't append a contradictory
+        # failure line after a valid measurement printed.
+        import threading
+
+        def _deadline():
+            if _bench_done.is_set():
+                return
+            _fail("watchdog: bench did not complete within "
+                  f"{_DEADLINE_S}s (backend hung mid-run after a "
+                  "successful usability probe)", flush=True)
+            os._exit(1)
+
+        _DEADLINE_S = int(os.environ.get("QT_BENCH_DEADLINE", 1500))
+        timer = threading.Timer(_DEADLINE_S, _deadline)
+        timer.daemon = True
+        timer.start()
 
     n_nodes = int(os.environ.get("QT_BENCH_NODES", defaults["nodes"]))
     avg_deg = int(os.environ.get("QT_BENCH_AVG_DEG", defaults["deg"]))
@@ -320,7 +351,7 @@ def main():
             mode = "window"
             seps = measure(batches, "window", layout, 61, shuffle=shuffle)
     out = {
-        "metric": "sampled-edges/sec (ogbn-products-scale, fanout [15,10,5], batch 1024)",
+        "metric": METRIC,
         "value": round(seps, 1),
         "unit": "edges/s",
         "vs_baseline": round(seps / BASELINE_SEPS, 3),
@@ -343,7 +374,14 @@ def main():
         out["vs_baseline"] = None
         out["exact_mode_vs_baseline"] = None
         out["window_mode_vs_baseline"] = None
-    print(json.dumps(out))
+    _bench_done.set()
+    print(json.dumps(out), flush=True)
+
+
+# set once the measurement JSON is about to print; the watchdog checks
+# it so late teardown hangs don't overwrite a valid result
+import threading as _threading
+_bench_done = _threading.Event()
 
 
 if __name__ == "__main__":

@@ -37,6 +37,9 @@ def main():
     p.add_argument("--classes", type=int, default=5)
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--weighted", action="store_true",
+                   help="attention-weighted draws on the cites relation "
+                        "(per-relation edge_weight + with_eid)")
     args = p.parse_args()
 
     import jax
@@ -67,8 +70,20 @@ def main():
              for t, c in counts.items()}
     feats["paper"] += 2.0 * centers["paper"][labels]
 
+    sampler_kw = {}
+    if args.weighted:
+        # per-relation weighted (attention) draws: bias the cites
+        # relation toward "influential" citations (synthetic exponential
+        # weights, CSR-slot-aligned); with_eid stamps each sampled edge
+        # with its slot so downstream attention can look weights back up
+        cites = topo.rels[("paper", "cites", "paper")]
+        e = int(np.asarray(cites.indices).shape[0])
+        sampler_kw = dict(
+            edge_weight={("paper", "cites", "paper"):
+                         rng.exponential(1.0, e).astype(np.float32)},
+            with_eid=True)
     sampler = HeteroGraphSageSampler(topo, sizes=[4, 3], seed_type="paper",
-                                     seed=0)
+                                     seed=0, **sampler_kw)
     model = RGCN(hidden_dim=64, out_dim=args.classes, num_layers=2,
                  seed_type="paper", dropout=0.0)
     tx = optax.adam(3e-3)

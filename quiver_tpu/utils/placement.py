@@ -13,32 +13,53 @@ import jax
 
 from ..debug import log as _log
 
-# platform -> bool; a capability PROBE, not a platform allowlist: the
-# failure mode being guarded (today's CPU backend ACCEPTS the
-# pinned_host placement and then fails compiling any op mixing host-
-# and default-space operands — placement succeeds, every later use
-# raises) is a property of the installed jax/backend pair, so it is
-# probed once per platform with a tiny mixed-space op instead of
-# hardcoding a platform string that would silently force the fallback
-# regime on a future jax where CPU host-offload works.
+# (platform, mesh?) -> bool; a capability PROBE, not a platform
+# allowlist: the failure mode being guarded (today's CPU backend
+# ACCEPTS the pinned_host placement and then fails compiling any op
+# mixing host- and default-space operands — placement succeeds, every
+# later use raises) is a property of the installed jax/backend pair,
+# so it is probed with a tiny mixed-space op instead of hardcoding a
+# platform string that would silently force the fallback regime on a
+# future jax where CPU host-offload works. Probed per sharding FORM
+# (single-device vs mesh NamedSharding) because the two can differ.
 _USABLE: dict = {}
 
 
-def _host_offload_usable(dev) -> bool:
-    key = getattr(dev, "platform", None)
+def _definitive(e: Exception) -> bool:
+    """True when the failure is the compile/placement capability gap
+    itself (cacheable), not a transient backend error that would
+    otherwise lock a long-lived process into the fallback regime."""
+    msg = str(e).lower()
+    return isinstance(e, NotImplementedError) or \
+        "memory_space" in msg or "memory kind" in msg or \
+        "memory_kind" in msg or "pinned_host" in msg
+
+
+def _host_offload_usable(dev, mesh=None) -> bool:
+    key = (getattr(dev, "platform", None), mesh is not None)
     got = _USABLE.get(key)
     if got is None:
+        import numpy as np
         try:
-            import numpy as np
-            sh = jax.sharding.SingleDeviceSharding(
-                dev, memory_kind="pinned_host")
+            if mesh is not None:
+                sh = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(),
+                    memory_kind="pinned_host")
+                main_sh = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec())
+            else:
+                sh = jax.sharding.SingleDeviceSharding(
+                    dev, memory_kind="pinned_host")
+                main_sh = dev
             host = jax.device_put(np.ones((8,), np.float32), sh)
-            main = jax.device_put(np.ones((8,), np.float32), dev)
+            main = jax.device_put(np.ones((8,), np.float32), main_sh)
             # the exact usage pattern the offload tiers need: one jitted
             # computation over a host-space and a default-space operand
             float(jax.jit(lambda h, m: (h + m).sum())(host, main))
             got = True
-        except Exception:  # noqa: BLE001 - any failure means unusable
+        except Exception as e:  # noqa: BLE001 - classify, maybe cache
+            if not _definitive(e):
+                return False    # transient: fail this call, don't cache
             got = False
         _USABLE[key] = got
     return got
@@ -60,7 +81,7 @@ def pinned_put(arrays, dev, allow_fallback, what, mesh=None):
     additionally measured on chip by benchmarks/host_mode_probe.py."""
     try:
         probe_dev = mesh.devices.flat[0] if mesh is not None else dev
-        if not _host_offload_usable(probe_dev):
+        if not _host_offload_usable(probe_dev, mesh=mesh):
             raise NotImplementedError(
                 "this backend accepts pinned_host placement but cannot "
                 "compile mixed-memory-space ops (probed)")
