@@ -35,7 +35,6 @@ def stage(name):
 
 try:
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     backend = jax.default_backend()
