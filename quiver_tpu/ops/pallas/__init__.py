@@ -1,10 +1,28 @@
-"""Pallas TPU kernels for the hot paths.
+"""Pallas TPU kernels — EXPERIMENTAL status (provisionally retired).
 
-Kernels land here as they replace the jnp reference implementations in
-``quiver_tpu.ops`` (which remain the correctness oracles):
+Status (round 5, see docs/introduction.md "Custom kernels:
+wire-or-retire"): the production L3 for both hot ops is the jnp/XLA
+path, not these kernels. The decision is provisional-by-necessity —
+the TPU backend outage that began in round 3 has prevented either
+kernel from ever executing on hardware — but the jnp evidence alone
+supports it:
 
-- sample_kernel: warp-per-seed equivalent of CSRRowWiseSampleKernel
-- gather_kernel: sparse feature row gather (quiver_tensor_gather)
+- feature gather: ``jnp.take`` sustains 230.5 GB/s on one v5e chip
+  (vs the reference's published 14.82 GB/s single-GPU UVA gather,
+  Introduction_en.md:92-95) — the XLA gather already saturates a
+  usable fraction of HBM for 100-1024-float rows, leaving little
+  headroom for ``gather.py`` to win;
+- sampling: the wide-row-fetch redesign (rotation/window/wide-exact in
+  ``ops/sample.py``) reached 73.33M SEPS = 2.14x the reference on
+  chip, by restructuring memory access around 128-lane rows rather
+  than accelerating the reference's warp-per-seed shape that
+  ``sample_kernel.py`` mirrors (cuda_random.cu.hpp:7-69).
+
+The kernels stay importable and interpret-mode-tested (they mirror the
+jnp correctness oracles, and ``bench_sampler.py --pallas`` /
+``bench_feature.py --pallas`` stay wired in ``chip_suite4.sh``), so
+the moment hardware returns the decision can be revisited with
+numbers. They are NOT on any production call path.
 """
 
 __all__ = []
