@@ -69,6 +69,38 @@ class TestReductions:
         np.testing.assert_allclose(np.asarray(f2[jnp.asarray(ids)]),
                                    feat[ids], rtol=1e-6)
 
+    def test_feature_pickle_preserves_cold_budget(self, rng):
+        feat = rng.standard_normal((64, 4)).astype(np.float32)
+        f = qv.Feature(device_cache_size=32 * 4 * 4, cold_budget=8)
+        f.from_cpu_tensor(feat)
+        f2 = pickle.loads(pickle.dumps(f))
+        assert f2.cold_budget == 8
+        ids = np.array([0, 31, 32, 63])
+        np.testing.assert_allclose(np.asarray(f2[jnp.asarray(ids)]),
+                                   feat[ids], rtol=1e-6)
+        # pre-cold_budget pickles (older state dicts) load with defaults
+        state = f.__getstate__()
+        state.pop("cold_budget")
+        f3 = qv.Feature.__new__(qv.Feature)
+        f3.__setstate__(state)
+        assert f3.cold_budget is None
+
+    def test_hetero_feature_pickles(self, rng):
+        feats = {"a": rng.standard_normal((30, 4)).astype(np.float32),
+                 "b": rng.standard_normal((10, 4)).astype(np.float32)}
+        hf = qv.HeteroFeature.from_cpu_tensors(
+            feats, configs={"a": dict(device_cache_size=10 * 4 * 4)},
+            default=dict(device_cache_size="1M"))
+        hf.prefetch({"a": jnp.asarray([1, 2])}).result()  # arm the pool
+        hf2 = pickle.loads(pickle.dumps(hf))
+        out = hf2.lookup({"a": jnp.asarray([0, 29, -1]),
+                          "b": jnp.asarray([9])})
+        want = feats["a"][[0, 29, 0]].copy()
+        want[2] = 0.0
+        np.testing.assert_allclose(np.asarray(out["a"]), want, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["b"]), feats["b"][[9]],
+                                   rtol=1e-6)
+
 
 class TestAsyncSampler:
     def test_per_layer_api(self, small_graph, rng):

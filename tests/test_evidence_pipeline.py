@@ -1,0 +1,71 @@
+"""Evidence-pipeline unit tests: the suite-log transcriber that turns
+benchmarks/chip_suite.log into committed measurement records (round-5
+automation — the recover->run->transcribe->commit loop must not depend
+on a human reading raw logs)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "benchmarks"))
+
+from transcribe_log import main as transcribe_main, parse_steps  # noqa: E402
+
+SAMPLE_LOG = """\
+Fri Jul 31 03:17:43 UTC 2026
+=== canary ===
+{"usable": true, "backend": "tpu", "h2d_MBps": 412.0}
+=== python -u bench.py ===
+some compile chatter
+{"metric": "sampled-edges/sec", "value": 73327929.9, "unit": "edges/s", "vs_baseline": 2.138}
+=== python -u benchmarks/bench_feature.py ===
+[xla-take float32] 3.20 GB in 0.014s -> 230.52 GB/s
+=== python -u benchmarks/debug_dispatch.py ===
+=== FAILED rc=124 (124=timeout): python -u benchmarks/debug_dispatch.py ===
+Fri Jul 31 04:00:00 UTC 2026
+"""
+
+
+class TestParseSteps:
+    def test_groups_result_lines_by_step(self):
+        steps = list(parse_steps(SAMPLE_LOG))
+        cmds = [c for c, _ in steps]
+        assert cmds == ["canary", "python -u bench.py",
+                        "python -u benchmarks/bench_feature.py",
+                        "python -u benchmarks/debug_dispatch.py"]
+        by_cmd = dict(steps)
+        assert any("73327929.9" in l for l in by_cmd["python -u bench.py"])
+        assert any("230.52 GB/s" in l
+                   for l in by_cmd["python -u benchmarks/bench_feature.py"])
+        # failure markers survive as result lines
+        assert any(l.startswith("FAILED rc=124")
+                   for l in by_cmd["python -u benchmarks/debug_dispatch.py"])
+        # chatter does not
+        assert not any("compile chatter" in l
+                       for ls in by_cmd.values() for l in ls)
+
+    def test_step_with_no_results_yields_empty(self):
+        steps = dict(parse_steps("=== lonely step ===\nnothing here\n"))
+        assert steps == {"lonely step": []}
+
+
+class TestTranscribeMain:
+    def test_appends_markdown_section(self, tmp_path):
+        log = tmp_path / "suite.log"
+        out = tmp_path / "meas.md"
+        log.write_text(SAMPLE_LOG)
+        out.write_text("# existing header\n")
+        rc = transcribe_main(["--log", str(log), "--out", str(out),
+                              "--marker", "RECOVERED-TEST"])
+        assert rc == 0
+        text = out.read_text()
+        assert text.startswith("# existing header\n")   # append, not clobber
+        assert "## RECOVERED-TEST" in text
+        assert "73327929.9" in text
+        assert "4 steps transcribed, 1 failed" in text
+
+    def test_missing_log_is_nonfatal(self, tmp_path, capsys):
+        rc = transcribe_main(["--log", str(tmp_path / "absent.log"),
+                              "--out", str(tmp_path / "o.md")])
+        assert rc == 1
+        assert not (tmp_path / "o.md").exists()
