@@ -32,6 +32,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 BASELINE_SEPS = 34.29e6   # reference Quiver UVA, 1 GPU, products [15,10,5]
@@ -102,6 +103,11 @@ def _fail(err, flush=False):
                       "vs_baseline": None, "error": err}), flush=flush)
 
 
+# set once the measurement JSON is about to print; the watchdog checks
+# it so late teardown hangs don't overwrite a valid result
+_bench_done = threading.Event()
+
+
 def main():
     platform = os.environ.get("QT_BENCH_PLATFORM", "")
     if "--platform" in sys.argv:
@@ -140,8 +146,6 @@ def main():
         # daemon thread + os._exit. _bench_done gates it so a
         # post-result teardown hang can't append a contradictory
         # failure line after a valid measurement printed.
-        import threading
-
         def _deadline():
             if _bench_done.is_set():
                 return
@@ -376,12 +380,6 @@ def main():
         out["window_mode_vs_baseline"] = None
     _bench_done.set()
     print(json.dumps(out), flush=True)
-
-
-# set once the measurement JSON is about to print; the watchdog checks
-# it so late teardown hangs don't overwrite a valid result
-import threading as _threading
-_bench_done = _threading.Event()
 
 
 if __name__ == "__main__":

@@ -257,31 +257,32 @@ class HeteroGraphSageSampler:
                     sub = jax.random.fold_in(key, step)
                     step += 1
                     indptr, indices = rels[et]
-                    slots = None
+
+                    def unpack(out):
+                        # (nbrs, counts[, slots]) -> (nbrs, slots|None)
+                        return (out[0], out[2] if with_eid else None)
+
                     w = weights.get(et)
                     if w is not None:
-                        out = sample_layer_weighted(
+                        nbrs, slots = unpack(sample_layer_weighted(
                             indptr, indices, w, cur, k, sub,
-                            with_slots=with_eid)
-                        (nbrs, _, slots) = out if with_eid else \
-                            (out[0], out[1], None)
+                            with_slots=with_eid))
                     elif method == "rotation":
                         nbrs, _ = sample_layer_rotation(
                             indptr, rows[et], cur, k, sub, stride=stride)
+                        slots = None
                     elif method == "window":
                         nbrs, _ = sample_layer_window(
                             indptr, rows[et], cur, k, sub, stride=stride)
+                        slots = None
                     elif rows is not None:
-                        out = sample_layer_exact_wide(
+                        nbrs, slots = unpack(sample_layer_exact_wide(
                             indptr, indices, rows[et], cur, k, sub,
-                            stride=stride, with_slots=with_eid)
-                        (nbrs, _, slots) = out if with_eid else \
-                            (out[0], out[1], None)
+                            stride=stride, with_slots=with_eid))
                     else:
-                        out = sample_layer(indptr, indices, cur, k, sub,
-                                           with_slots=with_eid)
-                        (nbrs, _, slots) = out if with_eid else \
-                            (out[0], out[1], None)
+                        nbrs, slots = unpack(sample_layer(
+                            indptr, indices, cur, k, sub,
+                            with_slots=with_eid))
                     if slots is not None and et in eids:
                         # CSR slot -> original COO edge id (CSRTopo.eid)
                         e = eids[et]
