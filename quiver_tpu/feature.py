@@ -259,7 +259,7 @@ class Feature:
 
         cold_budget = self.cold_budget
 
-        def lookup_tiered(dev_part, host_part, ids, order):
+        def lookup_tiered(dev_part, host_part, ids, order, masked=False):
             # one dispatch for the WHOLE tiered lookup: hot rows from
             # the HBM cache, cold rows gathered by XLA directly from
             # the (pinned host) cold tier — no Python round trip, no
@@ -275,13 +275,31 @@ class Feature:
             # count exceeds the budget falls back via ``lax.cond`` to
             # the full-batch host gather — correct in every case, only
             # the traffic bound degrades.
+            # masked=True (static): -1 ids produce zero rows, fused into
+            # the same dispatch (the hetero frontier path); the mask
+            # multiply lands on whichever return below fires
+            ids_raw = ids.astype(jnp.int32)
+            total = cache_rows + host_part.shape[0]
+            ids = jnp.clip(ids_raw, 0, total - 1) if masked else ids_raw
+
+            def finish(rows):
+                if not masked:
+                    return rows
+                return rows * (ids_raw >= 0).astype(rows.dtype)[:, None]
+
             t = translate(ids, order)
             hot = t < cache_rows
+            if masked:
+                # padding slots classify as HOT regardless of where
+                # clip(−1)→node 0 landed in storage: they must not
+                # consume cold_budget (a padded hetero frontier could
+                # otherwise trip the full-gather fallback every batch)
+                hot = hot | (ids_raw < 0)
             n = t.shape[0]
             cold_total = host_part.shape[0]
             cold_idx = jnp.clip(t - cache_rows, 0, max(cold_total - 1, 0))
             if dev_part is None:
-                return jnp.take(host_part, cold_idx, axis=0)
+                return finish(jnp.take(host_part, cold_idx, axis=0))
             hot_rows = gather_cached(dev_part, jnp.where(hot, t, 0))
 
             budget = (max(n // 4, 256) if cold_budget is None
@@ -290,7 +308,7 @@ class Feature:
                 # budget can't beat a full gather: keep the single
                 # unconditional host read (also the tiny-batch path)
                 cold_rows = jnp.take(host_part, cold_idx, axis=0)
-                return jnp.where(hot[:, None], hot_rows, cold_rows)
+                return finish(jnp.where(hot[:, None], hot_rows, cold_rows))
 
             cold = ~hot
             n_cold = jnp.sum(cold).astype(jnp.int32)
@@ -310,11 +328,12 @@ class Feature:
                 cold_rows = jnp.take(host_part, cold_idx, axis=0)
                 return jnp.where(hot[:, None], hot_rows, cold_rows)
 
-            return jax.lax.cond(n_cold > budget, _full,
-                                lambda _: narrow, None)
+            return finish(jax.lax.cond(n_cold > budget, _full,
+                                       lambda _: narrow, None))
 
         self._lookup_tiered_raw = lookup_tiered
-        self._lookup_tiered = jax.jit(lookup_tiered)
+        self._lookup_tiered = jax.jit(lookup_tiered,
+                                      static_argnums=(4,))
 
     # -- lookup (reference feature.py:296-333) ------------------------------
     def __getitem__(self, node_idx):
@@ -366,10 +385,14 @@ class Feature:
 
     def getitem_masked(self, node_idx):
         """``feature[clip(ids)]`` with -1-mask semantics: masked ids
-        produce zero rows. ONE dispatch on the pure-HBM path (the
-        hetero lookup's hot path over a tunnel); tiered paths compose
-        the mask around the tiered lookup."""
+        produce zero rows. ONE dispatch on the pure-HBM and fused
+        offload paths (the hetero lookup's hot path over a tunnel);
+        the numpy/disk tiers compose the mask around the lookup."""
         ids = jnp.asarray(node_idx)
+        if self._host_offload is not None and self.mmap_array is None:
+            return self._lookup_tiered(self.device_part,
+                                       self._host_offload, ids,
+                                       self.feature_order, True)
         if (self.host_part is None and self._host_offload is None
                 and self.mmap_array is None):
             return self._lookup_cached_masked(self.device_part, ids,

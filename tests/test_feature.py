@@ -545,6 +545,73 @@ class TestOffloadHostTier:
         assert narrow == [budget], reads      # bounded by the budget
         assert batch in fallback, reads       # full gather only in cond
 
+    def test_budgeted_lookup_randomized_property(self):
+        """Random hot/cold mixes x random budgets: the budgeted fused
+        lookup must equal the numpy path everywhere (the perf-critical
+        path earns a property sweep, not just boundary cases)."""
+        rng = np.random.default_rng(7)
+        n, dim = 300, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        for budget in (4, 16, 64):
+            f = qv.Feature(device_cache_size=150 * dim * 4,
+                           cold_budget=budget)
+            f.from_cpu_tensor(feat)
+            host = jnp.asarray(f.host_part)
+            for trial in range(6):
+                size = int(rng.integers(8, 128))
+                ids = jnp.asarray(rng.integers(0, n, size=size))
+                want = np.asarray(f[ids])
+                got = np.asarray(f._lookup_tiered(
+                    f.device_part, host, ids, f.feature_order))
+                np.testing.assert_allclose(
+                    got, want, rtol=1e-6,
+                    err_msg=f"budget={budget} trial={trial}")
+
+    def test_fused_masked_lookup_matches_composition(self):
+        """masked=True static arg: the one-dispatch tiered lookup with
+        -1-mask semantics equals clip+lookup+mask composition."""
+        rng = np.random.default_rng(9)
+        n, dim = 200, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=100 * dim * 4, cold_budget=8)
+        f.from_cpu_tensor(feat)
+        host = jnp.asarray(f.host_part)
+        ids = jnp.asarray(np.array([0, -1, 150, 99, -1, 100, 199]))
+        got = np.asarray(f._lookup_tiered(
+            f.device_part, host, ids, f.feature_order, True))
+        ids_np = np.asarray(ids)
+        want = feat[np.clip(ids_np, 0, n - 1)]
+        want[ids_np < 0] = 0.0
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_masked_padding_with_node0_in_cold_tier(self):
+        """Padding slots must classify as hot even when feature_order
+        maps node 0 (the clip target for -1) into the cold tier — they
+        must not consume cold_budget or corrupt results."""
+        rng = np.random.default_rng(11)
+        n, dim = 120, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=60 * dim * 4, cold_budget=4)
+        f.from_cpu_tensor(feat)
+        # force logical node 0 into the cold tier: storage row >= cache
+        order = np.arange(n, dtype=np.int32)
+        order[0], order[100] = order[100], order[0]
+        storage = np.empty_like(feat)
+        storage[order] = feat
+        f.device_part = jnp.asarray(storage[:60])
+        f.host_part = np.ascontiguousarray(storage[60:])
+        f.feature_order = jnp.asarray(order)
+        f._build_gather()
+        host = jnp.asarray(f.host_part)
+        ids_np = np.full(64, -1, np.int64)
+        ids_np[:3] = [5, 0, 119]            # mix: hot, cold(0), cold
+        got = np.asarray(f._lookup_tiered(
+            f.device_part, host, jnp.asarray(ids_np),
+            f.feature_order, True))
+        want = np.zeros((64, dim), np.float32)
+        want[:3] = feat[[5, 0, 119]]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
     def test_offload_on_cpu_falls_back_loudly(self, caplog):
         import logging
         rng = np.random.default_rng(0)
