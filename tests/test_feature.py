@@ -372,6 +372,30 @@ class TestDistFeatureSPMD:
         with pytest.raises(ValueError, match="multiple of the host count"):
             dist[jnp.arange(13, dtype=jnp.int32)]
 
+    def test_2d_mesh_host_by_chip(self, rng):
+        """Production topology is host x chip: features row-sharded
+        over the DCN ``host`` axis, replicated over the intra-host
+        ``chip`` axis (per-host batches are chip-replicated). The fused
+        lookup's shard_map specs name only ``host``, so the chip axis
+        must come along for free."""
+        n, dim, hosts = 64, 8, 4
+        full = rng.standard_normal((n, dim)).astype(np.float32)
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        mesh = Mesh(np.array(jax.devices()).reshape(hosts, 2),
+                    axis_names=("host", "chip"))
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        dist = qv.DistFeature.from_partition(full, info, comm)
+        ids = rng.integers(0, n, size=hosts * 16).astype(np.int32)
+        ids[::7] = -1
+        out = np.asarray(dist[jnp.asarray(ids)])
+        valid = ids >= 0
+        np.testing.assert_allclose(out[valid], full[ids[valid]],
+                                   rtol=1e-6)
+        assert (out[~valid] == 0).all()
+
     def test_bf16_dtype(self, rng):
         full = rng.standard_normal((64, 8)).astype(np.float32)
         g2h = (np.arange(64) % 8).astype(np.int32)
