@@ -12,7 +12,9 @@ products-like scale) — no multi-hundred-MB host->device transfer, which
 matters when the chip sits behind a slow tunnel.
 
 Scale knobs (env): QT_BENCH_NODES, QT_BENCH_AVG_DEG, QT_BENCH_BATCHES,
-QT_BENCH_BATCH.
+QT_BENCH_BATCH. QT_BENCH_DEADLINE (default 1500 s) bounds the whole
+run: a mid-run backend hang prints a failure JSON and exits instead of
+hanging the caller.
 
 Robustness: the TPU backend sits behind a tunnel that can hang
 indefinitely at init (not just error). Before touching the backend in
