@@ -25,9 +25,11 @@ SUITES=${*:-"benchmarks/chip_suite4.sh benchmarks/chip_suite5.sh"}
 
 # usability probe, not a presence probe: jax.devices() can answer while
 # the device claim is wedged (r5 lesson) — canary.py times a real
-# bounded round trip
+# bounded round trip. PROBE_CMD override exists so the recovery path
+# itself is testable without a TPU (tests/test_evidence_pipeline.py).
+PROBE_CMD=${PROBE_CMD:-"timeout 180 python benchmarks/canary.py 150"}
 probe() {
-    timeout 180 python benchmarks/canary.py 150 >/dev/null 2>&1
+    $PROBE_CMD >/dev/null 2>&1
 }
 
 echo "$(date) armed: suites=[$SUITES] out=$OUT_MD" | tee -a "$LOG"

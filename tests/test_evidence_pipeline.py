@@ -69,3 +69,53 @@ class TestTranscribeMain:
                               "--out", str(tmp_path / "o.md")])
         assert rc == 1
         assert not (tmp_path / "o.md").exists()
+
+
+class TestArmWatchRecoveryPath:
+    """End-to-end dry run of arm_watch.sh's recovery branch in a
+    scratch git repo: probe succeeds (stubbed), the fake suite appends
+    to the suite log, the transcriber writes the measurements doc, and
+    the evidence commit lands despite *.log being gitignored. This is
+    the exact unattended path the round depends on — it must not have
+    its first-ever execution during a real recovery."""
+
+    def test_recover_transcribe_commit(self, tmp_path):
+        import shutil
+        import subprocess
+        repo = tmp_path / "r"
+        (repo / "benchmarks").mkdir(parents=True)
+        (repo / "docs").mkdir()
+        src = os.path.join(os.path.dirname(__file__), "..", "benchmarks")
+        for f in ("arm_watch.sh", "transcribe_log.py"):
+            shutil.copy(os.path.join(src, f), repo / "benchmarks" / f)
+        (repo / ".gitignore").write_text("*.log\n")
+        (repo / "benchmarks" / "fake_suite.sh").write_text(
+            "cd \"$(dirname \"$0\")/..\"\n"
+            "echo '=== fake bench ===' >> benchmarks/chip_suite.log\n"
+            "echo '{\"metric\": \"seps\", \"value\": 1.0, "
+            "\"vs_baseline\": 2.5}' >> benchmarks/chip_suite.log\n")
+
+        def run(*cmd):
+            return subprocess.run(cmd, cwd=repo, capture_output=True,
+                                  text=True, timeout=120)
+
+        run("git", "init", "-q")
+        run("git", "config", "user.email", "t@t")
+        run("git", "config", "user.name", "t")
+        run("git", "add", ".gitignore")
+        run("git", "commit", "-qm", "init")
+
+        env = dict(os.environ, PROBE_CMD="true",
+                   OUT_MD="docs/meas.md", PROBE_SLEEP="1")
+        r = subprocess.run(
+            ["sh", "benchmarks/arm_watch.sh", "benchmarks/fake_suite.sh"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        meas = (repo / "docs" / "meas.md").read_text()
+        assert "fake bench" in meas and "2.5" in meas
+        log = run("git", "log", "--oneline", "--stat").stdout
+        assert "Auto-transcribed" in log
+        # the gitignored raw log made it into the commit (-f path)
+        assert "chip_suite.log" in log
+        assert "meas.md" in log
