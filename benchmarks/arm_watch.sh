@@ -21,7 +21,7 @@ LOG=benchmarks/chip_watch_auto.log
 OUT_MD=${OUT_MD:-docs/measurements_auto.md}
 PROBE_SLEEP=${PROBE_SLEEP:-390}
 MAX_PROBES=${MAX_PROBES:-110}
-SUITES=${*:-"benchmarks/chip_suite4.sh benchmarks/chip_suite5.sh"}
+SUITES=${*:-"benchmarks/chip_suite_quick.sh benchmarks/chip_suite4.sh benchmarks/chip_suite5.sh"}
 
 # usability probe, not a presence probe: jax.devices() can answer while
 # the device claim is wedged (r5 lesson) — canary.py times a real

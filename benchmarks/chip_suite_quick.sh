@@ -1,0 +1,26 @@
+#!/bin/sh
+# FIRST thing to run in a recovery window: the metric of record, pinned
+# to the expected-winner config (overlap layout + butterfly reshuffle —
+# the r3 sweep's fastest arm plus the sort-cost fix) so a short-lived
+# window still yields a driver-comparable headline before the full
+# sweeps start. The 03:17 r5 recovery lasted under 30 minutes — the
+# full bench.py sweep alone may not fit one. Appends to
+# benchmarks/chip_suite.log; run chip_suite4.sh + chip_suite5.sh after.
+cd "$(dirname "$0")/.."
+LOG=benchmarks/chip_suite.log
+. benchmarks/_suite_common.sh
+
+date | tee -a "$LOG"
+
+if ! canary; then
+    echo "canary: device unusable; aborting quick suite" | tee -a "$LOG"
+    exit 1
+fi
+
+# one rotation config + short exact/window side figures; also warms the
+# persistent compile cache for the full sweep that follows
+step env QT_BENCH_LAYOUT=overlap QT_BENCH_SHUFFLE=butterfly \
+    python -u bench.py
+
+date | tee -a "$LOG"
+echo "quick suite complete -> $LOG"
