@@ -500,6 +500,17 @@ def sample_layer_exact_wide(indptr: jax.Array, indices: jax.Array,
     scattered gather for that batch — exactness holds in every case,
     only the speedup degrades.
 
+    How often does the fallback fire? Distributional analysis (numpy,
+    2M-node samples; not a hardware measurement): on the products-scale
+    lognormal degree model (mu=ln 25, sigma=1) a uniform 1024-seed
+    batch averages ~24 hub rows and a degree-biased hop frontier (seeds
+    arrive proportional to in-degree) ~163 — vs the 512 default budget,
+    overflow is a 30-100 sigma event, and the big later hops
+    (s=180k, budget 90k vs ~29k expected hubs) sit further out still.
+    The cond exists for pathological dense graphs where most rows
+    exceed the window; there the wide fetch has no advantage and the
+    full scatter is the right behavior anyway.
+
     Unlike rotation/window, NO reshuffle is needed: the Fisher-Yates
     positions are uniform under any fixed row order, so
     ``indices_rows`` is just a layout view (``as_index_rows`` /
