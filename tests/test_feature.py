@@ -653,3 +653,24 @@ class TestOffloadHostTier:
     def test_bad_host_placement_rejected(self):
         with pytest.raises(ValueError, match="host_placement"):
             qv.Feature(host_placement="gpu")
+
+
+class TestCacheStatsLog:
+    def test_expected_hit_rate_logged(self, rng, small_graph, caplog):
+        import logging
+        indptr, indices = small_graph                 # 200-node fixture
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        n = topo.node_count
+        feat = rng.standard_normal((n, 8)).astype(np.float32)
+        f = qv.Feature(device_cache_size=(n * 2 // 5) * 8 * 4,
+                       csr_topo=topo)
+        with caplog.at_level(logging.INFO, logger="quiver_tpu"):
+            f.from_cpu_tensor(feat)
+        msgs = [r.message for r in caplog.records
+                if "expected hit rate" in r.message]
+        assert msgs, caplog.records
+        # degree-ordered cache of 40% of rows must cover MORE than 40%
+        # of degree mass on a non-uniform graph
+        import re
+        pct = float(re.search(r"~([\d.]+)%", msgs[0]).group(1))
+        assert pct > 40.0
