@@ -85,6 +85,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
     ]
     lib.qt_sample_layer.restype = None
+    lib.qt_sample_layer_weighted.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    lib.qt_sample_layer_weighted.restype = None
     lib.qt_reindex.argtypes = [
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
@@ -165,6 +173,59 @@ def cpu_sample_layer(indptr: np.ndarray, indices: np.ndarray,
     return _numpy_sample_layer(indptr, indices, seeds, k, seed)
 
 
+def cpu_sample_layer_weighted(indptr: np.ndarray, indices: np.ndarray,
+                              weights: np.ndarray, seeds: np.ndarray,
+                              k: int, seed: int = 0, row_cap: int = 2048,
+                              num_threads: int = 0
+                              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per seed: k draws WITH replacement ~ edge weight among the first
+    min(deg, row_cap) neighbors — the device contract
+    (ops/weighted.py), so host and device batches interleave with
+    identical distributions. Returns (nbrs [s, k] -1 fill, counts
+    = min(deg, k), 0 for zero-mass rows — which come back fully
+    masked, like the device path)."""
+    indptr = np.ascontiguousarray(indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    weights = np.ascontiguousarray(weights, dtype=np.float32)
+    seeds = np.ascontiguousarray(seeds, dtype=np.int32)
+    s = seeds.shape[0]
+    nbrs = np.empty((s, k), dtype=np.int32)
+    counts = np.empty((s,), dtype=np.int32)
+    lib = get_lib()
+    if lib is not None:
+        lib.qt_sample_layer_weighted(
+            _ptr(indptr, ctypes.c_int64), _ptr(indices, ctypes.c_int32),
+            _ptr(weights, ctypes.c_float), _ptr(seeds, ctypes.c_int32),
+            s, k, row_cap, seed & (2 ** 64 - 1),
+            _ptr(nbrs, ctypes.c_int32), _ptr(counts, ctypes.c_int32),
+            num_threads)
+        return nbrs, counts
+    return _numpy_sample_layer_weighted(indptr, indices, weights, seeds,
+                                        k, seed, row_cap)
+
+
+def _numpy_sample_layer_weighted(indptr, indices, weights, seeds, k, seed,
+                                 row_cap):
+    rng = np.random.default_rng(seed)
+    s = seeds.shape[0]
+    nbrs = np.full((s, k), -1, dtype=np.int32)
+    counts = np.zeros((s,), dtype=np.int32)
+    for i, v in enumerate(seeds):
+        if v < 0:
+            continue
+        lo, hi = indptr[v], indptr[v + 1]
+        deg = int(hi - lo)
+        pool = min(deg, row_cap)
+        w = np.clip(weights[lo:lo + pool].astype(np.float64), 0.0, None)
+        total = w.sum()
+        if total <= 0.0 or min(deg, k) == 0:
+            continue            # zero-mass/empty row: counts stays 0
+        counts[i] = min(deg, k)
+        picks = rng.choice(pool, size=counts[i], replace=True, p=w / total)
+        nbrs[i, :counts[i]] = indices[lo + picks]
+    return nbrs, counts
+
+
 def _numpy_sample_layer(indptr, indices, seeds, k, seed):
     rng = np.random.default_rng(seed)
     s = seeds.shape[0]
@@ -185,18 +246,27 @@ def _numpy_sample_layer(indptr, indices, seeds, k, seed):
 
 def cpu_sample_multihop(indptr, indices, seeds: np.ndarray,
                         sizes: Sequence[int], seed: int = 0,
-                        num_threads: int = 0
+                        num_threads: int = 0, weights=None,
+                        row_cap: int = 2048
                         ) -> Tuple[np.ndarray, List[np.ndarray], List[np.ndarray]]:
     """Host mirror of the device multi-hop sampler: identical shapes
-    (static caps, -1 fill) so results interleave freely with device output.
+    (static caps, -1 fill) so results interleave freely with device
+    output. With ``weights`` (CSR-slot-aligned), every hop draws
+    weighted-with-replacement like the device's edge_weight path.
     """
     indptr = np.ascontiguousarray(indptr, dtype=np.int64)
     indices = np.ascontiguousarray(indices, dtype=np.int32)
     cur = np.ascontiguousarray(seeds, dtype=np.int32)
     rows, cols = [], []
     for li, k in enumerate(sizes):
-        nbrs, _counts = cpu_sample_layer(
-            indptr, indices, cur, k, seed=seed + li, num_threads=num_threads)
+        if weights is not None:
+            nbrs, _counts = cpu_sample_layer_weighted(
+                indptr, indices, weights, cur, k, seed=seed + li,
+                row_cap=row_cap, num_threads=num_threads)
+        else:
+            nbrs, _counts = cpu_sample_layer(
+                indptr, indices, cur, k, seed=seed + li,
+                num_threads=num_threads)
         n_id, _count, row, col = cpu_reindex(cur, nbrs)
         rows.append(row)
         cols.append(col)

@@ -65,9 +65,93 @@ void sample_range(const int64_t *indptr, const int32_t *indices,
     }
 }
 
+void sample_range_weighted(const int64_t *indptr, const int32_t *indices,
+                           const float *weights, const int32_t *seeds,
+                           int64_t lo, int64_t hi, int32_t k,
+                           int32_t row_cap, uint64_t seed,
+                           int32_t *out_nbrs, int32_t *out_counts) {
+    // k draws WITH replacement proportional to edge weight, among the
+    // first min(deg, row_cap) neighbors — the device contract
+    // (ops/weighted.py sample_layer_weighted, itself mirroring the
+    // reference weight_sample, cuda_random.cu.hpp:178-221). row_cap
+    // matches the device default so host and device batches interleave
+    // with identical distributions in the mixed sampler.
+    std::vector<double> cdf(row_cap);
+    for (int64_t i = lo; i < hi; ++i) {
+        int32_t *out = out_nbrs + i * k;
+        const int32_t v = seeds[i];
+        if (v < 0) {
+            out_counts[i] = 0;
+            std::fill(out, out + k, -1);
+            continue;
+        }
+        const int64_t row_start = indptr[v];
+        const int64_t deg = indptr[v + 1] - row_start;
+        const int64_t pool = std::min<int64_t>(deg, row_cap);
+        double total = 0.0;
+        for (int64_t t = 0; t < pool; ++t) {
+            const float w = weights[row_start + t];
+            total += w > 0.0f ? (double)w : 0.0;
+            cdf[t] = total;
+        }
+        if (total <= 0.0) {
+            // zero-mass row: fully masked AND counts = 0 — the device
+            // contract (ops/weighted.py zeroes counts when total <= 0)
+            out_counts[i] = 0;
+            std::fill(out, out + k, -1);
+            continue;
+        }
+        out_counts[i] = static_cast<int32_t>(std::min<int64_t>(deg, k));
+        uint64_t state = seed ^ (0xD1B54A32D192ED03ULL * (uint64_t)(v + 1));
+        for (int32_t t = 0; t < k; ++t) {
+            if (t >= out_counts[i]) { out[t] = -1; continue; }
+            const double u =
+                (double)(splitmix64(state) >> 11) * (1.0 / 9007199254740992.0)
+                * total;               // 53-bit uniform in [0, total)
+            const int64_t p =
+                std::upper_bound(cdf.begin(), cdf.begin() + pool, u) -
+                cdf.begin();
+            out[t] = indices[row_start + std::min<int64_t>(p, pool - 1)];
+        }
+    }
+}
+
 }  // namespace
 
 extern "C" {
+
+// Weighted (attention) draw: k picks with replacement ~ edge weight per
+// seed, pool truncated at row_cap. out_nbrs [num_seeds * k] (-1 fill),
+// out_counts [num_seeds] = min(deg, k), or 0 for zero-mass rows
+// (nbrs all -1) — matching ops/weighted.py.
+void qt_sample_layer_weighted(const int64_t *indptr, const int32_t *indices,
+                              const float *weights, const int32_t *seeds,
+                              int64_t num_seeds, int32_t k, int32_t row_cap,
+                              uint64_t seed, int32_t *out_nbrs,
+                              int32_t *out_counts, int32_t num_threads) {
+    if (num_seeds == 0) return;
+    if (row_cap < 1) row_cap = 1;
+    int32_t nt = num_threads > 0
+                     ? num_threads
+                     : (int32_t)std::thread::hardware_concurrency();
+    nt = std::max(1, std::min<int32_t>(nt, (int32_t)num_seeds));
+    if (nt == 1) {
+        sample_range_weighted(indptr, indices, weights, seeds, 0, num_seeds,
+                              k, row_cap, seed, out_nbrs, out_counts);
+        return;
+    }
+    std::vector<std::thread> threads;
+    const int64_t chunk = (num_seeds + nt - 1) / nt;
+    for (int32_t t = 0; t < nt; ++t) {
+        const int64_t lo = t * chunk;
+        const int64_t hi = std::min(num_seeds, lo + chunk);
+        if (lo >= hi) break;
+        threads.emplace_back(sample_range_weighted, indptr, indices, weights,
+                             seeds, lo, hi, k, row_cap, seed, out_nbrs,
+                             out_counts);
+    }
+    for (auto &th : threads) th.join();
+}
 
 // Sample up to k neighbors (uniform, without replacement) per seed.
 // out_nbrs: [num_seeds * k] (-1 fill), out_counts: [num_seeds].

@@ -112,10 +112,23 @@ class GraphSageSampler:
         self.csr_topo = csr_topo
         self.device = device
         # CSR-slot-aligned edge weights => weighted (attention) sampling;
-        # use ops.weighted.csr_weights_from_eid for COO-ordered weights
+        # use ops.weighted.csr_weights_from_eid for COO-ordered weights.
+        # CPU mode draws through the native engine's weighted path
+        # (qt_sample_layer_weighted) with the same row_cap truncation,
+        # so host and device draws share one distribution. Length is
+        # validated HERE: the native engine reads weights[slot] through
+        # a raw pointer, so a short array would be an out-of-bounds
+        # read, not a Python exception.
+        if edge_weight is not None:
+            e = int(csr_topo.edge_count)
+            got = int(np.shape(edge_weight)[0])
+            if got != e:
+                raise ValueError(
+                    f"edge_weight has {got} entries but the topology "
+                    f"has {e} edges (weights are CSR-slot-aligned; use "
+                    "ops.csr_weights_from_eid for COO-ordered weights)")
         self.edge_weight = edge_weight
-        if edge_weight is not None and mode == "CPU":
-            raise ValueError("weighted sampling runs on the device path")
+        self._weight_np = None     # cached f32 copy for the CPU engine
         # sampling="rotation": ~3x faster device path (wide row fetches
         # per seed over a shuffled CSR copy instead of k scattered
         # loads); "window" costs the same fetches but draws exact i.i.d.
@@ -451,9 +464,16 @@ class GraphSageSampler:
     def _sample_cpu(self, seeds, bs):
         from ..native import cpu_sample_multihop
         indptr, indices = self._placed
+        if self.edge_weight is not None and self._weight_np is None:
+            # one-time f32 contiguous copy (an E-sized memcpy per batch
+            # would dwarf the sampling work on big graphs)
+            self._weight_np = np.ascontiguousarray(self.edge_weight,
+                                                   dtype=np.float32)
+        w = self._weight_np
         n_id, rows, cols = cpu_sample_multihop(
             indptr, indices, np.asarray(seeds), self.sizes,
-            seed=int(jax.random.randint(self.next_key(), (), 0, 2 ** 31 - 1)))
+            seed=int(jax.random.randint(self.next_key(), (), 0, 2 ** 31 - 1)),
+            weights=w)
         shapes = layer_shapes(bs, self.sizes)
         adjs = []
         for (row, col), shape in zip(zip(rows, cols), shapes):
@@ -546,26 +566,35 @@ class MixedGraphSageSampler:
         self.job = sample_job
         self.sizes = list(sizes)
         self.num_workers = max(1, num_workers)
-        # device_sampler_kwargs pass through to the DEVICE side only
-        # (sampling="rotation", layout=, shuffle=); the host side always
-        # runs the native exact engine. Semantics-CHANGING kwargs are
-        # rejected: batches interleave nondeterministically between the
-        # two engines, so with_eid (host emits e_id=None) or edge_weight
-        # (host draws uniformly) would yield an inconsistent stream that
-        # fails or skews only when a host batch happens to be scheduled.
-        for bad in ("with_eid", "edge_weight"):
-            if device_sampler_kwargs.get(bad) not in (None, False):
-                raise ValueError(
-                    f"{bad} is not supported by the mixed sampler: the "
-                    "host engine cannot match it, and which batches come "
-                    "from the host is timing-dependent — use a pure "
-                    "device GraphSageSampler for that workload")
+        # device_sampler_kwargs pass through to the DEVICE side
+        # (sampling="rotation", layout=, shuffle=). edge_weight ALSO
+        # reaches the host side: the native engine's weighted path
+        # draws with the same contract (k with-replacement picks ~
+        # weight, row_cap truncation), so batches from either engine
+        # share one distribution. with_eid stays rejected — the host
+        # engine emits e_id=None, and which batches come from the host
+        # is timing-dependent, so the stream would be inconsistent.
+        if device_sampler_kwargs.get("with_eid") not in (None, False):
+            raise ValueError(
+                "with_eid is not supported by the mixed sampler: the "
+                "host engine cannot match it, and which batches come "
+                "from the host is timing-dependent — use a pure "
+                "device GraphSageSampler for that workload")
+        if device_sampler_kwargs.get("edge_weight") is not None and \
+                device_sampler_kwargs.get("sampling", "exact") != "exact":
+            raise ValueError(
+                "mixed weighted sampling pins sampling='exact': the "
+                "host engine mirrors the exact weighted pool draw, and "
+                "the weighted windowed draw (rotation/window) is a "
+                "different distribution — batches would skew depending "
+                "on which engine produced them")
         self._device_kwargs = dict(device_sampler_kwargs)
         self.device_sampler = GraphSageSampler(
             csr_topo, sizes, device=device, mode=device_mode, seed=seed,
             **device_sampler_kwargs)
         self.cpu_sampler = GraphSageSampler(
-            csr_topo, sizes, mode="CPU", seed=seed + 1)
+            csr_topo, sizes, mode="CPU", seed=seed + 1,
+            edge_weight=device_sampler_kwargs.get("edge_weight"))
         self._pool = None
         self._device_time = None       # EMA seconds per device task
         self._cpu_time = None          # EMA seconds per host task
