@@ -78,11 +78,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    # ABI gate: raises AttributeError on a stale .so whose
+    # qt_sample_layer* still have the pre-out_slots signatures (the
+    # names alone would bind and silently return garbage slots);
+    # get_lib()'s except-path then rebuilds or falls back to numpy
+    lib.qt_abi_v2
     lib.qt_sample_layer.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
         ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32),
-        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
     ]
     lib.qt_sample_layer.restype = None
     lib.qt_sample_layer_weighted.argtypes = [
@@ -90,7 +96,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
     ]
     lib.qt_sample_layer_weighted.restype = None
     lib.qt_reindex.argtypes = [
@@ -154,30 +160,36 @@ def _ptr(arr, ctype):
 
 def cpu_sample_layer(indptr: np.ndarray, indices: np.ndarray,
                      seeds: np.ndarray, k: int, seed: int = 0,
-                     num_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
-    """Per seed: up to k distinct uniform neighbors. (-1 fill, counts)."""
+                     num_threads: int = 0, with_slots: bool = False):
+    """Per seed: up to k distinct uniform neighbors. Returns
+    (nbrs [s, k] -1 fill, counts); with ``with_slots`` additionally
+    each pick's flat CSR slot ([s, k] int64, -1 fill) — the input to
+    edge-id lookups, mirroring the device samplers."""
     indptr = np.ascontiguousarray(indptr, dtype=np.int64)
     indices = np.ascontiguousarray(indices, dtype=np.int32)
     seeds = np.ascontiguousarray(seeds, dtype=np.int32)
     s = seeds.shape[0]
     nbrs = np.empty((s, k), dtype=np.int32)
     counts = np.empty((s,), dtype=np.int32)
+    slots = np.empty((s, k), dtype=np.int64) if with_slots else None
     lib = get_lib()
     if lib is not None:
         lib.qt_sample_layer(
             _ptr(indptr, ctypes.c_int64), _ptr(indices, ctypes.c_int32),
             _ptr(seeds, ctypes.c_int32), s, k, seed & (2 ** 64 - 1),
             _ptr(nbrs, ctypes.c_int32), _ptr(counts, ctypes.c_int32),
+            None if slots is None else _ptr(slots, ctypes.c_int64),
             num_threads)
-        return nbrs, counts
-    return _numpy_sample_layer(indptr, indices, seeds, k, seed)
+        return (nbrs, counts, slots) if with_slots else (nbrs, counts)
+    return _numpy_sample_layer(indptr, indices, seeds, k, seed,
+                               with_slots=with_slots)
 
 
 def cpu_sample_layer_weighted(indptr: np.ndarray, indices: np.ndarray,
                               weights: np.ndarray, seeds: np.ndarray,
                               k: int, seed: int = 0, row_cap: int = 2048,
-                              num_threads: int = 0
-                              ) -> Tuple[np.ndarray, np.ndarray]:
+                              num_threads: int = 0,
+                              with_slots: bool = False):
     """Per seed: k draws WITH replacement ~ edge weight among the first
     min(deg, row_cap) neighbors — the device contract
     (ops/weighted.py), so host and device batches interleave with
@@ -191,6 +203,7 @@ def cpu_sample_layer_weighted(indptr: np.ndarray, indices: np.ndarray,
     s = seeds.shape[0]
     nbrs = np.empty((s, k), dtype=np.int32)
     counts = np.empty((s,), dtype=np.int32)
+    slots = np.empty((s, k), dtype=np.int64) if with_slots else None
     lib = get_lib()
     if lib is not None:
         lib.qt_sample_layer_weighted(
@@ -198,18 +211,21 @@ def cpu_sample_layer_weighted(indptr: np.ndarray, indices: np.ndarray,
             _ptr(weights, ctypes.c_float), _ptr(seeds, ctypes.c_int32),
             s, k, row_cap, seed & (2 ** 64 - 1),
             _ptr(nbrs, ctypes.c_int32), _ptr(counts, ctypes.c_int32),
+            None if slots is None else _ptr(slots, ctypes.c_int64),
             num_threads)
-        return nbrs, counts
+        return (nbrs, counts, slots) if with_slots else (nbrs, counts)
     return _numpy_sample_layer_weighted(indptr, indices, weights, seeds,
-                                        k, seed, row_cap)
+                                        k, seed, row_cap,
+                                        with_slots=with_slots)
 
 
 def _numpy_sample_layer_weighted(indptr, indices, weights, seeds, k, seed,
-                                 row_cap):
+                                 row_cap, with_slots=False):
     rng = np.random.default_rng(seed)
     s = seeds.shape[0]
     nbrs = np.full((s, k), -1, dtype=np.int32)
     counts = np.zeros((s,), dtype=np.int32)
+    slots = np.full((s, k), -1, dtype=np.int64) if with_slots else None
     for i, v in enumerate(seeds):
         if v < 0:
             continue
@@ -223,52 +239,70 @@ def _numpy_sample_layer_weighted(indptr, indices, weights, seeds, k, seed,
         counts[i] = min(deg, k)
         picks = rng.choice(pool, size=counts[i], replace=True, p=w / total)
         nbrs[i, :counts[i]] = indices[lo + picks]
-    return nbrs, counts
+        if slots is not None:
+            slots[i, :counts[i]] = lo + picks
+    return (nbrs, counts, slots) if with_slots else (nbrs, counts)
 
 
-def _numpy_sample_layer(indptr, indices, seeds, k, seed):
+def _numpy_sample_layer(indptr, indices, seeds, k, seed, with_slots=False):
     rng = np.random.default_rng(seed)
     s = seeds.shape[0]
     nbrs = np.full((s, k), -1, dtype=np.int32)
     counts = np.zeros((s,), dtype=np.int32)
+    slots = np.full((s, k), -1, dtype=np.int64) if with_slots else None
     for i, v in enumerate(seeds):
         if v < 0:
             continue
-        row = indices[indptr[v]:indptr[v + 1]]
+        lo = indptr[v]
+        row = indices[lo:indptr[v + 1]]
         c = min(len(row), k)
         counts[i] = c
         if c == len(row):
-            nbrs[i, :c] = row
+            picks = np.arange(c)
         else:
-            nbrs[i, :c] = rng.choice(row, size=c, replace=False)
-    return nbrs, counts
+            picks = rng.choice(len(row), size=c, replace=False)
+        nbrs[i, :c] = row[picks]
+        if slots is not None:
+            slots[i, :c] = lo + picks
+    return (nbrs, counts, slots) if with_slots else (nbrs, counts)
 
 
 def cpu_sample_multihop(indptr, indices, seeds: np.ndarray,
                         sizes: Sequence[int], seed: int = 0,
                         num_threads: int = 0, weights=None,
-                        row_cap: int = 2048
-                        ) -> Tuple[np.ndarray, List[np.ndarray], List[np.ndarray]]:
+                        row_cap: int = 2048, with_slots: bool = False):
     """Host mirror of the device multi-hop sampler: identical shapes
     (static caps, -1 fill) so results interleave freely with device
     output. With ``weights`` (CSR-slot-aligned), every hop draws
     weighted-with-replacement like the device's edge_weight path.
+    Returns (n_id, rows, cols); with ``with_slots`` additionally a
+    per-hop list of flat CSR slots ([s*k] int64, -1 fill, aligned with
+    rows/cols) — the input to edge-id lookups.
     """
     indptr = np.ascontiguousarray(indptr, dtype=np.int64)
     indices = np.ascontiguousarray(indices, dtype=np.int32)
     cur = np.ascontiguousarray(seeds, dtype=np.int32)
-    rows, cols = [], []
+    rows, cols, slot_lists = [], [], []
     for li, k in enumerate(sizes):
         if weights is not None:
-            nbrs, _counts = cpu_sample_layer_weighted(
+            out = cpu_sample_layer_weighted(
                 indptr, indices, weights, cur, k, seed=seed + li,
-                row_cap=row_cap, num_threads=num_threads)
+                row_cap=row_cap, num_threads=num_threads,
+                with_slots=with_slots)
         else:
-            nbrs, _counts = cpu_sample_layer(
+            out = cpu_sample_layer(
                 indptr, indices, cur, k, seed=seed + li,
-                num_threads=num_threads)
+                num_threads=num_threads, with_slots=with_slots)
+        nbrs = out[0]
+        slots = out[2] if with_slots else None
         n_id, _count, row, col = cpu_reindex(cur, nbrs)
         rows.append(row)
         cols.append(col)
+        if with_slots:
+            # an edge masked during reindex (invalid seed) must mask
+            # its slot with it
+            slot_lists.append(np.where(col >= 0, slots.reshape(-1), -1))
         cur = n_id
+    if with_slots:
+        return cur, rows, cols, slot_lists
     return cur, rows, cols

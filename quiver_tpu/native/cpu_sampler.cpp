@@ -28,14 +28,20 @@ inline uint64_t splitmix64(uint64_t &state) {
 
 void sample_range(const int64_t *indptr, const int32_t *indices,
                   const int32_t *seeds, int64_t lo, int64_t hi, int32_t k,
-                  uint64_t seed, int32_t *out_nbrs, int32_t *out_counts) {
+                  uint64_t seed, int32_t *out_nbrs, int32_t *out_counts,
+                  int64_t *out_slots) {
+    // out_slots (nullable): each pick's flat CSR slot (-1 fill) — the
+    // input to edge-id lookups, mirroring the device samplers'
+    // with_slots outputs.
     std::vector<int64_t> pos(k), val(k);
     for (int64_t i = lo; i < hi; ++i) {
         int32_t *out = out_nbrs + i * k;
+        int64_t *slots = out_slots ? out_slots + i * k : nullptr;
         const int32_t v = seeds[i];
         if (v < 0) {
             out_counts[i] = 0;
             std::fill(out, out + k, -1);
+            if (slots) std::fill(slots, slots + k, (int64_t)-1);
             continue;
         }
         const int64_t row_start = indptr[v];
@@ -45,6 +51,10 @@ void sample_range(const int64_t *indptr, const int32_t *indices,
         if (deg <= k) {
             for (int64_t t = 0; t < deg; ++t) out[t] = indices[row_start + t];
             std::fill(out + deg, out + k, -1);
+            if (slots) {
+                for (int64_t t = 0; t < deg; ++t) slots[t] = row_start + t;
+                std::fill(slots + deg, slots + k, (int64_t)-1);
+            }
             continue;
         }
         uint64_t state = seed ^ (0xD1B54A32D192ED03ULL * (uint64_t)(v + 1));
@@ -58,6 +68,7 @@ void sample_range(const int64_t *indptr, const int32_t *indices,
             for (int w = written - 1; w >= 0; --w)
                 if (pos[w] == t) { a_t = val[w]; break; }
             out[t] = indices[row_start + a_j];
+            if (slots) slots[t] = row_start + a_j;
             pos[written] = j;
             val[written] = a_t;
             ++written;
@@ -69,7 +80,8 @@ void sample_range_weighted(const int64_t *indptr, const int32_t *indices,
                            const float *weights, const int32_t *seeds,
                            int64_t lo, int64_t hi, int32_t k,
                            int32_t row_cap, uint64_t seed,
-                           int32_t *out_nbrs, int32_t *out_counts) {
+                           int32_t *out_nbrs, int32_t *out_counts,
+                           int64_t *out_slots) {
     // k draws WITH replacement proportional to edge weight, among the
     // first min(deg, row_cap) neighbors — the device contract
     // (ops/weighted.py sample_layer_weighted, itself mirroring the
@@ -83,6 +95,9 @@ void sample_range_weighted(const int64_t *indptr, const int32_t *indices,
         if (v < 0) {
             out_counts[i] = 0;
             std::fill(out, out + k, -1);
+            if (out_slots)
+                std::fill(out_slots + i * k, out_slots + (i + 1) * k,
+                          (int64_t)-1);
             continue;
         }
         const int64_t row_start = indptr[v];
@@ -99,19 +114,28 @@ void sample_range_weighted(const int64_t *indptr, const int32_t *indices,
             // contract (ops/weighted.py zeroes counts when total <= 0)
             out_counts[i] = 0;
             std::fill(out, out + k, -1);
+            if (out_slots)
+                std::fill(out_slots + i * k, out_slots + (i + 1) * k,
+                          (int64_t)-1);
             continue;
         }
         out_counts[i] = static_cast<int32_t>(std::min<int64_t>(deg, k));
         uint64_t state = seed ^ (0xD1B54A32D192ED03ULL * (uint64_t)(v + 1));
         for (int32_t t = 0; t < k; ++t) {
-            if (t >= out_counts[i]) { out[t] = -1; continue; }
+            if (t >= out_counts[i]) {
+                out[t] = -1;
+                if (out_slots) out_slots[i * k + t] = -1;
+                continue;
+            }
             const double u =
                 (double)(splitmix64(state) >> 11) * (1.0 / 9007199254740992.0)
                 * total;               // 53-bit uniform in [0, total)
             const int64_t p =
                 std::upper_bound(cdf.begin(), cdf.begin() + pool, u) -
                 cdf.begin();
-            out[t] = indices[row_start + std::min<int64_t>(p, pool - 1)];
+            const int64_t slot = row_start + std::min<int64_t>(p, pool - 1);
+            out[t] = indices[slot];
+            if (out_slots) out_slots[i * k + t] = slot;
         }
     }
 }
@@ -119,6 +143,12 @@ void sample_range_weighted(const int64_t *indptr, const int32_t *indices,
 }  // namespace
 
 extern "C" {
+
+// ABI version marker. The ctypes loader REQUIRES this symbol: the
+// qt_sample_layer* signatures changed in v2 (appended out_slots), and
+// symbol-name lookup alone cannot detect a stale prebuilt .so with the
+// old signatures — calling one would silently return garbage slots.
+void qt_abi_v2(void) {}
 
 // Weighted (attention) draw: k picks with replacement ~ edge weight per
 // seed, pool truncated at row_cap. out_nbrs [num_seeds * k] (-1 fill),
@@ -128,7 +158,8 @@ void qt_sample_layer_weighted(const int64_t *indptr, const int32_t *indices,
                               const float *weights, const int32_t *seeds,
                               int64_t num_seeds, int32_t k, int32_t row_cap,
                               uint64_t seed, int32_t *out_nbrs,
-                              int32_t *out_counts, int32_t num_threads) {
+                              int32_t *out_counts, int64_t *out_slots,
+                              int32_t num_threads) {
     if (num_seeds == 0) return;
     if (row_cap < 1) row_cap = 1;
     int32_t nt = num_threads > 0
@@ -137,7 +168,8 @@ void qt_sample_layer_weighted(const int64_t *indptr, const int32_t *indices,
     nt = std::max(1, std::min<int32_t>(nt, (int32_t)num_seeds));
     if (nt == 1) {
         sample_range_weighted(indptr, indices, weights, seeds, 0, num_seeds,
-                              k, row_cap, seed, out_nbrs, out_counts);
+                              k, row_cap, seed, out_nbrs, out_counts,
+                              out_slots);
         return;
     }
     std::vector<std::thread> threads;
@@ -148,17 +180,18 @@ void qt_sample_layer_weighted(const int64_t *indptr, const int32_t *indices,
         if (lo >= hi) break;
         threads.emplace_back(sample_range_weighted, indptr, indices, weights,
                              seeds, lo, hi, k, row_cap, seed, out_nbrs,
-                             out_counts);
+                             out_counts, out_slots);
     }
     for (auto &th : threads) th.join();
 }
 
 // Sample up to k neighbors (uniform, without replacement) per seed.
 // out_nbrs: [num_seeds * k] (-1 fill), out_counts: [num_seeds].
+// out_slots (nullable): each pick's flat CSR slot, [num_seeds * k].
 void qt_sample_layer(const int64_t *indptr, const int32_t *indices,
                      const int32_t *seeds, int64_t num_seeds, int32_t k,
                      uint64_t seed, int32_t *out_nbrs, int32_t *out_counts,
-                     int32_t num_threads) {
+                     int64_t *out_slots, int32_t num_threads) {
     if (num_seeds == 0) return;
     int32_t nt = num_threads > 0
                      ? num_threads
@@ -166,7 +199,7 @@ void qt_sample_layer(const int64_t *indptr, const int32_t *indices,
     nt = std::max(1, std::min<int32_t>(nt, (int32_t)num_seeds));
     if (nt == 1) {
         sample_range(indptr, indices, seeds, 0, num_seeds, k, seed, out_nbrs,
-                     out_counts);
+                     out_counts, out_slots);
         return;
     }
     std::vector<std::thread> threads;
@@ -176,7 +209,7 @@ void qt_sample_layer(const int64_t *indptr, const int32_t *indices,
         const int64_t hi = std::min(num_seeds, lo + chunk);
         if (lo >= hi) break;
         threads.emplace_back(sample_range, indptr, indices, seeds, lo, hi, k,
-                             seed, out_nbrs, out_counts);
+                             seed, out_nbrs, out_counts, out_slots);
     }
     for (auto &th : threads) th.join();
 }

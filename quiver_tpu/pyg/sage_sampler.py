@@ -129,6 +129,7 @@ class GraphSageSampler:
                     "ops.csr_weights_from_eid for COO-ordered weights)")
         self.edge_weight = edge_weight
         self._weight_np = None     # cached f32 copy for the CPU engine
+        self._eid_np = None        # cached eid map for the CPU engine
         # sampling="rotation": ~3x faster device path (wide row fetches
         # per seed over a shuffled CSR copy instead of k scattered
         # loads); "window" costs the same fetches but draws exact i.i.d.
@@ -151,9 +152,9 @@ class GraphSageSampler:
         # with_eid: stamp every sampled edge with its global edge id
         # (CSRTopo.eid -> original COO position; CSR slot if no eid map),
         # delivered in Adj.e_id. Costs one scattered gather per edge, so
-        # it is opt-in; the CPU engine doesn't track slots.
-        if with_eid and mode == "CPU":
-            raise ValueError("with_eid is not supported in CPU mode")
+        # it is opt-in. CPU mode: the native engine emits each pick's
+        # CSR slot (qt_sample_layer* out_slots), mapped through
+        # CSRTopo.eid the same way.
         self.with_eid = with_eid
         self.sampling = sampling
         # layout="overlap": rotation/window do ONE 256-wide row gather
@@ -470,16 +471,33 @@ class GraphSageSampler:
             self._weight_np = np.ascontiguousarray(self.edge_weight,
                                                    dtype=np.float32)
         w = self._weight_np
-        n_id, rows, cols = cpu_sample_multihop(
+        out = cpu_sample_multihop(
             indptr, indices, np.asarray(seeds), self.sizes,
             seed=int(jax.random.randint(self.next_key(), (), 0, 2 ** 31 - 1)),
-            weights=w)
+            weights=w, with_slots=self.with_eid)
+        if self.with_eid:
+            n_id, rows, cols, slot_lists = out
+            if self._eid_np is None and self.csr_topo.eid is not None:
+                # one-time host copy (E-sized D2H per batch would dwarf
+                # the sampling work, like _weight_np above)
+                self._eid_np = np.asarray(self.csr_topo.eid)
+            eid_map = self._eid_np
+        else:
+            n_id, rows, cols = out
+            slot_lists = [None] * len(rows)
         shapes = layer_shapes(bs, self.sizes)
         adjs = []
-        for (row, col), shape in zip(zip(rows, cols), shapes):
+        for (row, col, slots), shape in zip(zip(rows, cols, slot_lists),
+                                            shapes):
             edge_index = jnp.asarray(np.stack([col, row]))
+            e_id = None
+            if slots is not None:
+                e = (slots if eid_map is None
+                     else np.where(slots >= 0,
+                                   eid_map[np.clip(slots, 0, None)], -1))
+                e_id = jnp.asarray(e)
             adjs.append(Adj(edge_index=edge_index,
-                            e_id=None,  # CPU engine doesn't track slots
+                            e_id=e_id,
                             size=(shape.n_id_cap, shape.num_seeds),
                             mask=edge_index[0] >= 0))
         return jnp.asarray(n_id), bs, adjs[::-1]
@@ -567,19 +585,13 @@ class MixedGraphSageSampler:
         self.sizes = list(sizes)
         self.num_workers = max(1, num_workers)
         # device_sampler_kwargs pass through to the DEVICE side
-        # (sampling="rotation", layout=, shuffle=). edge_weight ALSO
-        # reaches the host side: the native engine's weighted path
-        # draws with the same contract (k with-replacement picks ~
-        # weight, row_cap truncation), so batches from either engine
-        # share one distribution. with_eid stays rejected — the host
-        # engine emits e_id=None, and which batches come from the host
-        # is timing-dependent, so the stream would be inconsistent.
-        if device_sampler_kwargs.get("with_eid") not in (None, False):
-            raise ValueError(
-                "with_eid is not supported by the mixed sampler: the "
-                "host engine cannot match it, and which batches come "
-                "from the host is timing-dependent — use a pure "
-                "device GraphSageSampler for that workload")
+        # (sampling="rotation", layout=, shuffle=). edge_weight and
+        # with_eid ALSO reach the host side: the native engine's
+        # weighted path draws with the device pool draw's contract (k
+        # with-replacement picks ~ weight, row_cap truncation) and its
+        # samplers emit per-pick CSR slots mapped through CSRTopo.eid —
+        # so batches from either engine share one distribution and one
+        # e_id semantics regardless of timing-dependent provenance.
         if device_sampler_kwargs.get("edge_weight") is not None and \
                 device_sampler_kwargs.get("sampling", "exact") != "exact":
             raise ValueError(
@@ -594,7 +606,8 @@ class MixedGraphSageSampler:
             **device_sampler_kwargs)
         self.cpu_sampler = GraphSageSampler(
             csr_topo, sizes, mode="CPU", seed=seed + 1,
-            edge_weight=device_sampler_kwargs.get("edge_weight"))
+            edge_weight=device_sampler_kwargs.get("edge_weight"),
+            with_eid=bool(device_sampler_kwargs.get("with_eid", False)))
         self._pool = None
         self._device_time = None       # EMA seconds per device task
         self._cpu_time = None          # EMA seconds per host task

@@ -218,10 +218,24 @@ class TestEdgeIdTracking:
         assert all(adj.e_id is None for adj in adjs)
         assert all(adj.mask is not None for adj in adjs)
 
-    def test_cpu_mode_rejects_with_eid(self, rng):
-        _, topo = _coo_graph(rng)
-        with pytest.raises(ValueError):
-            qv.GraphSageSampler(topo, [4], mode="CPU", with_eid=True)
+    def test_cpu_mode_with_eid(self, rng):
+        """r5: the native engine emits per-pick CSR slots; CPU-mode
+        e_id must name real original COO edges exactly like the device
+        path's (check_eids)."""
+        coo, topo = _coo_graph(rng)
+        s = qv.GraphSageSampler(topo, [4, 3], mode="CPU", with_eid=True)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = s.sample(seeds)
+        check_eids(coo, n_id, adjs)
+
+    def test_cpu_mode_with_eid_weighted(self, rng):
+        coo, topo = _coo_graph(rng)
+        w = rng.random(topo.edge_count).astype(np.float32)
+        s = qv.GraphSageSampler(topo, [4], mode="CPU", with_eid=True,
+                                edge_weight=w)
+        seeds = rng.choice(topo.node_count, 16, replace=False)
+        n_id, bs, adjs = s.sample(seeds)
+        check_eids(coo, n_id, adjs)
 
 
 class TestNativeCPUEngine:
@@ -324,9 +338,18 @@ class TestMixedSampler:
             mixed.share_ipc())
         assert rebuilt.device_sampler.sampling == "rotation"
         assert rebuilt.device_sampler.shuffle == "butterfly"
-        # semantics-changing kwargs are rejected
-        with pytest.raises(ValueError, match="mixed"):
-            qv.MixedGraphSageSampler(job, [3, 2], topo, with_eid=True)
+        # r5: with_eid flows to BOTH engines — every batch in the
+        # stream carries e_id regardless of provenance
+        m2 = qv.MixedGraphSageSampler(job, [3, 2], topo, num_workers=1,
+                                      with_eid=True)
+        got = list(iter(m2))
+        assert got and all(adj.e_id is not None
+                           for _, _, adjs in got for adj in adjs)
+        # weighted + rotation stays rejected (distribution mismatch)
+        with pytest.raises(ValueError, match="exact"):
+            qv.MixedGraphSageSampler(
+                job, [3, 2], topo, sampling="rotation",
+                edge_weight=np.ones(topo.edge_count, np.float32))
 
     def test_adapts_quota_to_skewed_speeds(self, topo):
         # skew the measured per-task times and assert the host quota
