@@ -56,7 +56,12 @@ def main():
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--sampling", default="rotation",
                    choices=["exact", "rotation", "window"])
+    p.add_argument("--weighted", action="store_true",
+                   help="attention-weighted draws on BOTH engines "
+                        "(forces sampling=exact; r5 native weighted path)")
     args = p.parse_args()
+    if args.weighted:
+        args.sampling = "exact"
 
     from _common import configure_jax
     jax = configure_jax()
@@ -80,6 +85,10 @@ def main():
     dev_kwargs = dict(sampling=args.sampling)
     if args.sampling in ("rotation", "window"):
         dev_kwargs.update(layout="overlap", shuffle="butterfly")
+    if args.weighted:
+        dev_kwargs.update(
+            edge_weight=rng.exponential(1.0, int(indptr[-1]))
+            .astype(np.float32))
 
     def run_device_only():
         s = qv.GraphSageSampler(topo, args.sizes, mode="HBM", seed=0,

@@ -29,6 +29,7 @@ step python -u benchmarks/bench_e2e.py --method exact
 # 4. mixed sampler adaptivity: device-only vs mixed + converged split
 step python -u benchmarks/bench_mixed.py --sampling rotation
 step python -u benchmarks/bench_mixed.py --sampling exact
+step python -u benchmarks/bench_mixed.py --weighted
 
 # 5. hetero sampler per-mode cost (r4 perf modes) vs homog rotation anchor
 step python -u benchmarks/bench_hetero.py
