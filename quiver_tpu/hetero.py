@@ -26,9 +26,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ops.sample import (as_index_rows, as_index_rows_overlapping,
-                         compact_union, edge_row_ids, reshuffle_csr,
-                         sample_layer, sample_layer_exact_wide,
-                         sample_layer_rotation, sample_layer_window)
+                         compact_union, compose_slot_map, edge_row_ids,
+                         reshuffle_csr, sample_layer,
+                         sample_layer_exact_wide, sample_layer_rotation,
+                         sample_layer_window)
 from .ops.weighted import sample_layer_weighted
 from .pyg.sage_sampler import Adj
 from .utils import CSRTopo
@@ -110,8 +111,10 @@ class HeteroGraphSageSampler:
     contract (cuda_random.cu.hpp:178-221); unlisted relations keep the
     uniform exact draw. ``with_eid=True`` stamps every sampled edge's
     ``Adj.e_id`` with its global edge id (the relation's
-    ``CSRTopo.eid`` if set, else its CSR slot), -1 where masked. Both
-    are exact-mode only (see the ctor guards).
+    ``CSRTopo.eid`` if set, else its CSR slot), -1 where masked —
+    in every sampling mode (rotation/window compose per-relation
+    permuted slot maps across ``reshuffle()``). ``edge_weight`` is
+    exact-mode only (see the ctor guard).
     """
 
     def __init__(self, topo: HeteroCSRTopo, sizes: Sequence,
@@ -149,10 +152,10 @@ class HeteroGraphSageSampler:
         # weight_sample contract — cuda_random.cu.hpp:178-221);
         # unlisted relations keep the uniform exact draw. Same coupled-
         # param strictness as the homogeneous ctor: the weighted
-        # windowed draw's mandatory hub re-placement and the co-
-        # permuted slot maps only exist on the homogeneous
-        # rotation/window path, so weighted/eid hetero sampling is
-        # exact-mode only — an explicit error, not a silent downgrade.
+        # windowed draw's mandatory hub re-placement only exists on the
+        # homogeneous rotation/window path, so WEIGHTED hetero sampling
+        # is exact-mode only — an explicit error, not a silent
+        # downgrade. (with_eid works in every mode; see below.)
         if edge_weight is not None:
             unknown = set(edge_weight) - set(topo.rels)
             if unknown:
@@ -175,16 +178,15 @@ class HeteroGraphSageSampler:
                     raise ValueError(
                         f"edge_weight[{et}] has {int(np.shape(w)[0])} "
                         f"entries, relation has {e} edges")
-        if with_eid and sampling != "exact":
-            raise ValueError(
-                "with_eid supports sampling='exact' only for hetero "
-                "graphs (rotation/window slots live in per-epoch "
-                "permuted coordinates; the co-permuted slot map is a "
-                "homogeneous-sampler feature)")
         self.edge_weight = edge_weight
+        # with_eid works in every sampling mode: exact modes map raw
+        # CSR slots through the relation's eid map; rotation/window
+        # maintain per-relation CO-PERMUTED slot maps across reshuffles
+        # (the homogeneous sampler's _rot_eid pattern, per relation).
         self.with_eid = with_eid
         self._weights_placed = None
         self._eids_placed = None
+        self._rot_eids = {}      # {edge_type: permuted-slot -> edge id}
         self._key = jax.random.key(seed)
         self._fn_cache = {}
         self._rows = None        # {edge_type: rows view}
@@ -221,8 +223,27 @@ class HeteroGraphSageSampler:
                     jnp.asarray(t.indptr), int(indices.shape[0]))
                 self._row_ids[et] = rid
             src = (self._permuted.get(et, indices) if bfly else indices)
-            permuted = reshuffle_csr(src, rid, jax.random.fold_in(key, i),
-                                     method=self.shuffle)
+            out = reshuffle_csr(src, rid, jax.random.fold_in(key, i),
+                                method=self.shuffle,
+                                with_slot_map=self.with_eid)
+            if self.with_eid:
+                permuted, smap = out
+                # co-permuted edge-id map per relation (shared
+                # composition semantics: ops.compose_slot_map). The
+                # placed base eid is cached so sort mode doesn't
+                # re-transfer E-sized maps every epoch.
+                base = None
+                if t.eid is not None:
+                    if self._eids_placed is None:
+                        self._eids_placed = {}
+                    base = self._eids_placed.get(et)
+                    if base is None:
+                        base = jnp.asarray(t.eid)
+                        self._eids_placed[et] = base
+                self._rot_eids[et] = compose_slot_map(
+                    self._rot_eids.get(et), smap, base, bfly)
+            else:
+                permuted = out
             if bfly:
                 self._permuted[et] = permuted
             rows[et] = self._as_rows(permuted)
@@ -268,13 +289,13 @@ class HeteroGraphSageSampler:
                             indptr, indices, w, cur, k, sub,
                             with_slots=with_eid))
                     elif method == "rotation":
-                        nbrs, _ = sample_layer_rotation(
-                            indptr, rows[et], cur, k, sub, stride=stride)
-                        slots = None
+                        nbrs, slots = unpack(sample_layer_rotation(
+                            indptr, rows[et], cur, k, sub, stride=stride,
+                            with_slots=with_eid))
                     elif method == "window":
-                        nbrs, _ = sample_layer_window(
-                            indptr, rows[et], cur, k, sub, stride=stride)
-                        slots = None
+                        nbrs, slots = unpack(sample_layer_window(
+                            indptr, rows[et], cur, k, sub, stride=stride,
+                            with_slots=with_eid))
                     elif rows is not None:
                         nbrs, slots = unpack(sample_layer_exact_wide(
                             indptr, indices, rows[et], cur, k, sub,
@@ -373,10 +394,19 @@ class HeteroGraphSageSampler:
         if self.edge_weight is not None and self._weights_placed is None:
             self._weights_placed = {et: jnp.asarray(w)
                                     for et, w in self.edge_weight.items()}
-        if self.with_eid and self._eids_placed is None:
+        if self.with_eid and self.sampling == "exact" \
+                and self._eids_placed is None:
+            # rotation/window never read these (they use _rot_eids);
+            # building them there would place E-sized arrays for nothing
             self._eids_placed = {
                 et: jnp.asarray(t.eid)
                 for et, t in self.topo.rels.items() if t.eid is not None}
+        # rotation/window slots live in permuted coordinates: map them
+        # through the co-permuted per-relation maps instead of the raw
+        # topo eids
+        eids_arg = (self._rot_eids
+                    if self.sampling in ("rotation", "window")
+                    else self._eids_placed)
         fn = self._fn_cache.get(bs)
         if fn is None:
             fn = self._build(bs)
@@ -384,7 +414,7 @@ class HeteroGraphSageSampler:
         frontier, hops = fn(seeds, self.next_key(), self._rows,
                             self._rels_placed,
                             self._weights_placed or {},
-                            self._eids_placed or {})
+                            eids_arg or {})
         layers = [HeteroLayer(adjs=a, frontier=f, counts=c)
                   for a, f, c in hops]
         return frontier, bs, layers[::-1]

@@ -5,6 +5,7 @@ from .sample import (
     sample_layer_window,
     permute_csr,
     butterfly_shuffle,
+    compose_slot_map,
     reshuffle_csr,
     as_index_rows,
     as_index_rows_overlapping,
@@ -29,6 +30,7 @@ __all__ = [
     "sample_layer_window",
     "permute_csr",
     "butterfly_shuffle",
+    "compose_slot_map",
     "reshuffle_csr",
     "as_index_rows",
     "as_index_rows_overlapping",

@@ -284,6 +284,28 @@ def reshuffle_csr(indices: jax.Array, row_ids: jax.Array, key: jax.Array,
     raise ValueError(f"unknown reshuffle method {method!r}")
 
 
+def compose_slot_map(prev_map, smap: jax.Array, base, bfly: bool):
+    """Maintain a co-permuted slot -> edge-id map across reshuffles
+    (the correctness-critical composition both the homogeneous and the
+    hetero samplers rely on for ``with_eid`` under rotation/window —
+    keep it in ONE place).
+
+    - sort shuffles start from the ORIGINAL row order every epoch, so
+      the new map is ``smap`` (or ``base[smap]`` when the topology
+      carries an eid map) and ``prev_map`` is ignored;
+    - butterfly's ``smap`` is INPUT-relative (the shuffle composes on
+      the previous epoch's output), so the running map composes:
+      ``prev_map[smap]``, seeded from ``base``/identity on first use.
+    """
+    if not bfly:
+        return smap if base is None else jnp.asarray(base)[smap]
+    if prev_map is not None:
+        return prev_map[smap]
+    if base is not None:
+        return jnp.asarray(base)[smap]
+    return smap
+
+
 def as_index_rows(indices: jax.Array, width: int = 128) -> jax.Array:
     """Pad + reshape the CSR ``indices`` array into 128-wide rows. TPU
     random access costs ~25ns per gather *index* regardless of row width

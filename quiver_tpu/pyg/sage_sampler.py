@@ -340,16 +340,9 @@ class GraphSageSampler:
         else:
             permuted = out
         if self.with_eid:
-            if not bfly:
-                self._rot_eid = (smap if base is None
-                                 else jnp.asarray(base)[smap])
-            # butterfly smap is input-relative: compose the running map
-            elif self._rot_eid is not None:
-                self._rot_eid = self._rot_eid[smap]
-            elif base is not None:
-                self._rot_eid = jnp.asarray(base)[smap]
-            else:
-                self._rot_eid = smap
+            from ..ops.sample import compose_slot_map
+            self._rot_eid = compose_slot_map(self._rot_eid, smap, base,
+                                             bfly)
         if bfly:
             # (in HOST mode these are re-placed on pinned host in the
             # placement block below, AFTER the rows views are built —

@@ -497,6 +497,37 @@ class TestHeteroEidWeighted:
         # with 1e12:1 odds essentially every draw is the first slot
         assert hit_first / ok.sum() > 0.99
 
+    @pytest.mark.parametrize("sampling,shuffle", [
+        ("rotation", "sort"), ("rotation", "butterfly"),
+        ("window", "sort")])
+    def test_with_eid_rotation_window_across_reshuffles(self, rng,
+                                                        sampling, shuffle):
+        """r5: rotation/window eids via per-relation co-permuted slot
+        maps — e_id must name ORIGINAL COO edges on every epoch (the
+        butterfly arm exercises the composed map)."""
+        n = 60
+        src = rng.integers(0, n, 500).astype(np.int64)
+        dst = rng.integers(0, n, 500).astype(np.int64)
+        topo = qv.CSRTopo(edge_index=np.stack([src, dst]))
+        h = HeteroCSRTopo({("x", "r", "x"): topo},
+                          {"x": topo.node_count})
+        s = HeteroGraphSageSampler(h, sizes=[4], seed_type="x",
+                                   sampling=sampling, shuffle=shuffle,
+                                   with_eid=True)
+        seeds = rng.choice(topo.node_count, 8, replace=False)
+        for epoch in range(3):
+            _, _, layers = s.sample(seeds)
+            adj = layers[0].adjs[("x", "r", "x")]
+            src_front = np.asarray(layers[0].frontier["x"])
+            sl, dl = np.asarray(adj.edge_index)
+            e_id = np.asarray(adj.e_id)
+            ok = sl >= 0
+            assert ok.any()
+            for s_local, d_local, e in zip(sl[ok], dl[ok], e_id[ok]):
+                assert src[e] == seeds[d_local], (epoch, sampling)
+                assert dst[e] == src_front[s_local], (epoch, sampling)
+            s.reshuffle()
+
     def test_mixed_weighted_and_uniform_relations(self, mag_like, rng):
         et = ("author", "writes", "paper")
         e = int(np.asarray(mag_like.rels[et].indices.shape[0]))
@@ -516,9 +547,6 @@ class TestHeteroEidWeighted:
         with pytest.raises(ValueError, match="exact"):
             HeteroGraphSageSampler(mag_like, sizes=[3], seed_type="paper",
                                    sampling="rotation", edge_weight=w)
-        with pytest.raises(ValueError, match="exact"):
-            HeteroGraphSageSampler(mag_like, sizes=[3], seed_type="paper",
-                                   sampling="window", with_eid=True)
         with pytest.raises(ValueError, match="unknown relation"):
             HeteroGraphSageSampler(
                 mag_like, sizes=[3], seed_type="paper",
