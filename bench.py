@@ -182,7 +182,8 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import jax.numpy as jnp
     from quiver_tpu.ops import (sample_multihop, reshuffle_csr, edge_row_ids,
-                                as_index_rows, as_index_rows_overlapping)
+                                as_index_rows, as_index_rows_overlapping,
+                                exact_bucket_meta)
     # rotation row layout: "overlap" = one gather/seed, 2x index memory;
     # "pair" = two gathers/seed; "both" (default) measures the two and
     # reports the better as the metric of record, layout labeled
@@ -218,6 +219,11 @@ def main():
 
     row_ids = jax.jit(edge_row_ids, static_argnums=1)(indptr, e)
     jax.block_until_ready(row_ids)
+
+    # degree-bucket split for the wide-exact hub budget: computed once
+    # per graph (training caches it on CSRTopo), so it sits outside the
+    # timed region like the exact layout views
+    hub_frac = exact_bucket_meta(indptr).frac
 
     # graph arrays go in as jit *arguments*: closed-over device arrays are
     # embedded in the HLO as literal constants, which at this scale (~400MB
@@ -273,7 +279,10 @@ def main():
                                             method=method,
                                             indices_rows=rows,
                                             indices_stride=stride,
-                                            seeds_dense=True)
+                                            seeds_dense=True,
+                                            hub_frac=(hub_frac
+                                                      if method == "exact"
+                                                      else None))
                 edges = sum(l.edge_count.astype(jnp.int32) for l in layers)
                 return total + edges, None
             total, _ = jax.lax.scan(
@@ -329,6 +338,7 @@ def main():
     # config. Cheap — the winner is already compiled.
     seps = (measure(batches, "rotation", layout, 50, shuffle=shuffle)
             if len(by_cfg) > 1 else _sel)
+    rotation_seps = seps          # the rotation row of the per-mode block
     # secondary figures on a shorter epoch slice (clamped to the seeds
     # the node count can supply): exact i.i.d. mode, and window mode
     # (same row fetches as rotation, exact i.i.d. subsets of each
@@ -364,6 +374,11 @@ def main():
         "mode": mode,
         "layout": layout,
         "shuffle": shuffle,
+        # per-mode SEPS, uniformly keyed, so the exact-mode gap (the
+        # honest exact-vs-exact comparison against the reference's
+        # i.i.d. reservoir kernel) is tracked by the official metric
+        "rotation_mode_value": round(rotation_seps, 1),
+        "rotation_mode_vs_baseline": round(rotation_seps / BASELINE_SEPS, 3),
         "exact_mode_value": round(exact_seps, 1),
         "exact_mode_vs_baseline": round(exact_seps / BASELINE_SEPS, 3),
         "window_mode_value": round(window_seps, 1),
@@ -378,6 +393,7 @@ def main():
         # that ignores the platform key can't record a bogus comparison
         out["platform"] = "cpu-smoke"
         out["vs_baseline"] = None
+        out["rotation_mode_vs_baseline"] = None
         out["exact_mode_vs_baseline"] = None
         out["window_mode_vs_baseline"] = None
     _bench_done.set()

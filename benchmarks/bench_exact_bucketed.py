@@ -1,0 +1,242 @@
+"""A/B microbenchmark: the degree-bucketed exact hot path vs the
+pre-bucketing exact path, at bench scale.
+
+"Legacy" is a frozen in-file copy of the pre-PR implementation of the
+three pieces this PR changed — stable multi-operand sort compaction,
+k-pass onehot window extraction, blind bs//2 hub budget — so the ratio
+is reproducible from this one committed file regardless of how the
+library evolves. Both arms run the identical multi-hop structure
+(sample + compact per hop, seeds dense) and the identical draw
+distribution; only the execution strategy differs.
+
+Prints one JSON line:
+  {"new_seps", "legacy_seps", "speedup", "platform", scale...}
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/bench_exact_bucketed.py \
+           [--nodes N] [--avg-deg D] [--batch B] [--batches K]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks._common import configure_jax
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=200_000)
+    p.add_argument("--avg-deg", type=int, default=10)
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--batches", type=int, default=8)
+    p.add_argument("--sizes", type=int, nargs="+", default=[15, 10, 5])
+    args = p.parse_args()
+
+    jax = configure_jax()
+    import jax.numpy as jnp
+
+    from quiver_tpu.ops import (as_index_rows, exact_bucket_meta,
+                                sample_multihop)
+    from quiver_tpu.ops.sample import _fisher_yates_rows, _I32_MAX
+
+    n_nodes, avg_deg = args.nodes, args.avg_deg
+    batch, batches, sizes = args.batch, args.batches, list(args.sizes)
+    key = jax.random.key(0)
+
+    # ---- graph (same generator as bench.py) ----
+    ln = jax.random.normal(jax.random.fold_in(key, 1), (n_nodes,)) \
+        + jnp.log(float(avg_deg))
+    deg = jnp.clip(jnp.exp(ln).astype(jnp.int32), 0, 10_000)
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(deg)])
+    e = int(indptr[-1])
+    indices = jax.random.randint(jax.random.fold_in(key, 2), (e,), 0,
+                                 n_nodes, dtype=jnp.int32)
+    rows = jax.block_until_ready(jax.jit(as_index_rows)(indices))
+    hub_frac = exact_bucket_meta(indptr).frac
+
+    # ---- legacy arm: frozen pre-bucketing implementation ----
+    def legacy_extract_window_cols(w, pos, k):
+        wiota = jax.lax.broadcasted_iota(jnp.int32, (1, w.shape[1]), 1)
+        cols = []
+        for j in range(k):
+            onehot = wiota == pos[:, j][:, None]
+            cols.append(jnp.sum(jnp.where(onehot, w, 0), axis=1))
+        return jnp.stack(cols, axis=1).astype(jnp.int32)
+
+    def legacy_exact_wide(indptr, indices, indices_rows, seeds, k, key):
+        step, win = 128, 256
+        n = indptr.shape[0] - 1
+        valid = seeds >= 0
+        safe = jnp.clip(seeds, 0, max(n - 1, 0)).astype(indptr.dtype)
+        start = indptr[safe]
+        dg = jnp.where(valid, indptr[safe + 1] - start, 0) \
+            .astype(jnp.int32)
+        counts = jnp.minimum(dg, k)
+        bs = seeds.shape[0]
+        e = indices.shape[0]
+        picks = _fisher_yates_rows(key, dg, k)
+        off0 = (start % step).astype(jnp.int32)
+        low = dg <= (win - off0)
+        r0 = (start // step).astype(jnp.int32)
+        w = jnp.concatenate(
+            [indices_rows[r0], indices_rows[r0 + 1]], axis=1)
+        off = (start % step).astype(jnp.int32)
+        pos = off[:, None] + picks
+        nbrs = legacy_extract_window_cols(
+            w, jnp.where(low[:, None], pos, 0), k)
+        hub_cap = max(1, bs // 2)                  # the blind budget
+        iota = jnp.arange(bs, dtype=jnp.int32)
+        hub = (~low) & (dg > 0)
+        n_hub = jnp.sum(hub).astype(jnp.int32)
+        hrank = jnp.cumsum(hub).astype(jnp.int32) - 1
+        okey = jnp.where(hub & (hrank < hub_cap), hrank, _I32_MAX)
+        _, hpos = jax.lax.sort((okey, iota), num_keys=1)   # stable
+        hpos = hpos[:hub_cap]
+        h_valid = (jnp.arange(hub_cap, dtype=jnp.int32)
+                   < jnp.minimum(n_hub, hub_cap))
+        h_start = start[hpos]
+        h_picks = picks[hpos]
+        g = jnp.clip(h_start[:, None] + h_picks.astype(h_start.dtype),
+                     0, e - 1)
+        h_nbrs = indices[g].astype(jnp.int32)
+        tgt = jnp.where(h_valid, hpos, bs)
+        nbrs = nbrs.at[tgt].set(h_nbrs, mode="drop")
+        nbrs = jax.lax.cond(
+            n_hub > hub_cap,
+            lambda _: indices[jnp.clip(
+                start[:, None] + picks.astype(start.dtype), 0, e - 1)]
+            .astype(jnp.int32),
+            lambda _: nbrs, None)
+        mask = jnp.arange(k, dtype=jnp.int32)[None, :] < counts[:, None]
+        return jnp.where(mask, nbrs, -1), counts
+
+    def legacy_fill_from_run_start(values, at):
+        def combine(a, b):
+            av, asn = a
+            bv, bsn = b
+            return jnp.where(bsn, bv, av), asn | bsn
+        filled, _ = jax.lax.associative_scan(
+            combine, (jnp.where(at, values, 0), at))
+        return filled
+
+    def legacy_compact_core(ids, s):
+        # pre-PR dense-seed path: three cap-wide STABLE sorts
+        cap = ids.shape[0]
+        ids = ids.astype(jnp.int32)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        valid = ids >= 0
+        is_seed = (iota < s) & valid
+        B30 = jnp.int32(1 << 30)
+        idk = jnp.where(valid, ids, _I32_MAX)
+        tag = jnp.where(is_seed, 0, B30) | iota
+        sid, stag = jax.lax.sort((idk, tag), num_keys=2)
+        spos = stag & (B30 - 1)
+        srk = spos
+        sseed = stag < B30
+        flag = jnp.concatenate(
+            [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+        fvalid = sid != _I32_MAX
+        vseeds = jnp.sum(is_seed).astype(jnp.int32)
+        sflag = flag & sseed
+        nsflag = flag & fvalid & ~sseed
+        rs = jax.lax.cummax(jnp.where(flag, iota, -1), axis=0)
+        lss = jax.lax.cummax(jnp.where(sflag, iota, -1), axis=0)
+        in_seedrun = (lss == rs) & (lss >= 0)
+        if s < (1 << 18) and cap < (1 << 30):
+            srank = jnp.cumsum(sflag) - 1
+            hi = jax.lax.cummax(
+                jnp.where(sflag, (srank << 9) | (srk >> 9), -1), axis=0)
+            lo = jax.lax.cummax(
+                jnp.where(sflag, (srank << 9) | (srk & 511), -1), axis=0)
+            seed_local = ((hi & 511) << 9) | (lo & 511)
+        else:
+            seed_local = legacy_fill_from_run_start(srk, sflag)
+        nsrank = jnp.cumsum(nsflag).astype(jnp.int32) - 1
+        local_sorted = jnp.where(in_seedrun, seed_local, vseeds + nsrank)
+        n_count = (vseeds + jnp.sum(nsflag)).astype(jnp.int32)
+        okey = jnp.where(flag & fvalid, local_sorted, _I32_MAX)
+        _, n_id_payload = jax.lax.sort((okey, sid), num_keys=1)
+        n_id = jnp.where(iota < n_count, n_id_payload, -1)
+        _, local = jax.lax.sort((spos, local_sorted), num_keys=1)
+        return n_id, n_count, local
+
+    def legacy_compact_layer(seeds, nbrs):
+        s, k = nbrs.shape
+        n_id, n_count, local_ids = legacy_compact_core(
+            jnp.concatenate([seeds, nbrs.reshape(-1)]), s)
+        nbr_valid = nbrs.reshape(-1) >= 0
+        col = jnp.where(nbr_valid, local_ids[s:], -1)
+        seed_local = jax.lax.broadcast_in_dim(
+            local_ids[:s], (s, k), (0,)).reshape(-1)
+        row = jnp.where(nbr_valid, seed_local, -1)
+        edge_count = jnp.sum(nbr_valid).astype(jnp.int32)
+        return n_id, row, col, edge_count
+
+    # ---- epochs (identical structure, one device dispatch each) ----
+    def make_epoch(new_path):
+        @jax.jit
+        def run_epoch(indptr, indices, rows, key):
+            kseed, kbatch = jax.random.split(key)
+            seed_perm = jax.random.permutation(kseed, n_nodes)[
+                : batches * batch].astype(jnp.int32).reshape(
+                    batches, batch)
+
+            def one_batch(total, i):
+                seeds = jax.lax.dynamic_index_in_dim(
+                    seed_perm, i, axis=0, keepdims=False)
+                bkey = jax.random.fold_in(kbatch, i)
+                if new_path:
+                    _, layers = sample_multihop(
+                        indptr, indices, seeds, sizes, bkey,
+                        method="exact", indices_rows=rows,
+                        seeds_dense=True, hub_frac=hub_frac)
+                    edges = sum(l.edge_count.astype(jnp.int32)
+                                for l in layers)
+                else:
+                    cur = seeds
+                    edges = jnp.int32(0)
+                    for hi, k in enumerate(sizes):
+                        sub = jax.random.fold_in(bkey, hi)
+                        nbrs, _ = legacy_exact_wide(
+                            indptr, indices, rows, cur, k, sub)
+                        n_id, _, _, ec = legacy_compact_layer(cur, nbrs)
+                        edges = edges + ec
+                        cur = n_id
+                return total + edges, None
+
+            total, _ = jax.lax.scan(
+                one_batch, jnp.int32(0),
+                jnp.arange(batches, dtype=jnp.int32))
+            return total
+
+        return run_epoch
+
+    def measure(run, salt):
+        jax.block_until_ready(
+            run(indptr, indices, rows, jax.random.fold_in(key, salt)))
+        t0 = time.perf_counter()
+        total = int(run(indptr, indices, rows,
+                        jax.random.fold_in(key, salt + 1)))
+        return total / (time.perf_counter() - t0)
+
+    new_seps = measure(make_epoch(True), 100)
+    legacy_seps = measure(make_epoch(False), 200)
+    print(json.dumps({
+        "metric": "exact-mode sampled-edges/sec, bucketed vs legacy",
+        "new_seps": round(new_seps, 1),
+        "legacy_seps": round(legacy_seps, 1),
+        "speedup": round(new_seps / legacy_seps, 3),
+        "platform": jax.default_backend(),
+        "nodes": n_nodes, "avg_deg": avg_deg, "batch": batch,
+        "batches": batches, "sizes": sizes, "edges": e,
+        "hub_frac": round(hub_frac, 5),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Version-compat shims for the jax API surface this package uses.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` in the same move. The package targets the
+new spelling; this shim keeps older runtimes (>= 0.4.30) importable by
+translating the kwarg and resolving the symbol from wherever the
+installed jax provides it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# NOTE on old-jax GSPMD numerics (documented, deliberately NOT patched
+# here): the GSPMD paths assume value-stable partitioning — random draws
+# and sort/scan results identical regardless of how XLA shards the
+# program. jax 0.4.x falls short twice: jax_threefry_partitionable
+# defaults off (sharding-dependent random streams), and the CPU SPMD
+# partitioner itself produces sharding-dependent sort/compaction output,
+# which no config flag repairs. Flipping the threefry default from an
+# import would silently change EVERY seeded jax.random stream in the
+# host program — worse than the disease — so instead the gspmd parity
+# tests probe the partitioner and skip where it is not value-stable
+# (tests/test_gspmd.py), and users on modern jax (partitionable by
+# default, fixed partitioner) get stable results with no global
+# mutation.
+
+try:  # new-style: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _KWARG = "check_vma"
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _KWARG = "check_rep"
+
+
+def shard_map(f=None, /, *, mesh, in_specs, out_specs, check_vma=None,
+              **kwargs):
+    """``jax.shard_map`` with the modern signature on any supported jax."""
+    if check_vma is not None:
+        kwargs[_KWARG] = check_vma
+    if f is None:
+        return lambda g: _shard_map(g, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs, **kwargs)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """Construct the pallas-TPU compiler-params dataclass across the
+    ``TPUCompilerParams`` -> ``CompilerParams`` rename, dropping fields
+    (e.g. ``has_side_effects``) the installed version doesn't know."""
+    import dataclasses
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    known = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kwargs.items() if k in known})
+
+
+__all__ = ["shard_map", "pallas_tpu_compiler_params"]

@@ -29,7 +29,7 @@ from .ops.sample import (as_index_rows, as_index_rows_overlapping,
                          compact_union, compose_slot_map, edge_row_ids,
                          reshuffle_csr, sample_layer,
                          sample_layer_exact_wide, sample_layer_rotation,
-                         sample_layer_window)
+                         sample_layer_window, suggest_hub_cap)
 from .ops.weighted import sample_layer_weighted
 from .pyg.sage_sampler import Adj
 from .utils import CSRTopo
@@ -189,6 +189,7 @@ class HeteroGraphSageSampler:
         self._rot_eids = {}      # {edge_type: permuted-slot -> edge id}
         self._key = jax.random.key(seed)
         self._fn_cache = {}
+        self._hub_fracs = None   # {edge_type: static hub fraction}
         self._rows = None        # {edge_type: rows view}
         self._permuted = {}      # butterfly composition state
         self._row_ids = {}
@@ -257,6 +258,7 @@ class HeteroGraphSageSampler:
         stride = self._stride
         caps = self.frontier_cap
         with_eid = self.with_eid
+        hub_fracs = self._hub_fracs or {}
 
         # rels/rows enter as jit ARGUMENTS (pytrees), never closures: a
         # closed-over device array is embedded in the HLO as a literal
@@ -297,9 +299,16 @@ class HeteroGraphSageSampler:
                             indptr, rows[et], cur, k, sub, stride=stride,
                             with_slots=with_eid))
                     elif rows is not None:
+                        # scattered-load budget from the relation's own
+                        # cached degree-bucket split (CSRTopo metadata,
+                        # shared across batch sizes and epochs); static
+                        # because the frontier width is a compile-time
+                        # shape
                         nbrs, slots = unpack(sample_layer_exact_wide(
                             indptr, indices, rows[et], cur, k, sub,
-                            stride=stride, with_slots=with_eid))
+                            stride=stride, with_slots=with_eid,
+                            hub_cap=suggest_hub_cap(
+                                int(cur.shape[0]), hub_fracs.get(et))))
                     else:
                         nbrs, slots = unpack(sample_layer(
                             indptr, indices, cur, k, sub,
@@ -387,6 +396,13 @@ class HeteroGraphSageSampler:
                               for et, t in self.topo.rels.items()
                               if not (self.edge_weight
                                       and et in self.edge_weight)}
+                # one cached degree-bucket split per relation sizes the
+                # static hub budget (CSRTopo caches it, so a topology
+                # shared by several samplers computes it once)
+                self._hub_fracs = {
+                    et: float(self.topo.rels[et]
+                              .exact_bucket_meta(step=128).frac)
+                    for et in self._rows}
         if self._rels_placed is None:
             self._rels_placed = {
                 et: (jnp.asarray(t.indptr), jnp.asarray(t.indices))

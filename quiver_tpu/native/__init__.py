@@ -21,26 +21,56 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "cpu_sampler.cpp")
-_LIB_PATH = os.path.join(_HERE, "_cpu_sampler.so")
+#: bump together with the qt_abi_vN gate in _bind(); the filename is
+#: ABI-versioned so a .so built for an older ABI is simply never found
+#: (vs silently binding and failing the gate)
+_ABI = 2
+_LIB_PATH = os.path.join(_HERE, f"_cpu_sampler_v{_ABI}.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
-def _build() -> Optional[str]:
+def _build(dst: str) -> Optional[str]:
+    """Compile the engine to ``dst``. The compile goes to a scratch file
+    first and lands via os.replace, so a concurrent process that already
+    mapped an old ``dst`` keeps its (old-inode) image instead of having
+    a live ELF truncated under it."""
+    tmp = f"{dst}.tmp.{os.getpid()}"
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-pthread", _SRC, "-o", _LIB_PATH]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _LIB_PATH
-    except (OSError, subprocess.SubprocessError):
-        # retry without -march=native (some toolchains lack it)
+           "-pthread", _SRC, "-o", tmp]
+    for attempt in (cmd, [c for c in cmd if c != "-march=native"]):
+        # second attempt drops -march=native (some toolchains lack it)
         try:
-            cmd.remove("-march=native")
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            return _LIB_PATH
+            subprocess.run(attempt, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, dst)
+            return dst
         except (OSError, subprocess.SubprocessError):
-            return None
+            continue
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    return None
+
+
+def _fresh_lib_path() -> str:
+    """A never-before-dlopened filename for rebuild recovery: glibc
+    dedupes dlopen by pathname, so re-CDLLing a rebuilt ``_LIB_PATH``
+    would just rebind the stale image already mapped in this process.
+    Building under a fresh name sidesteps the cache entirely. Prefer
+    the package dir (where the canonical .so demonstrably dlopens —
+    system tempdirs are often mounted noexec); fall back to the
+    tempdir only when the package dir is unwritable."""
+    try:
+        fd, path = tempfile.mkstemp(prefix=f"_cpu_sampler_v{_ABI}_",
+                                    suffix=".so", dir=_HERE)
+    except OSError:
+        fd, path = tempfile.mkstemp(prefix=f"_cpu_sampler_v{_ABI}_",
+                                    suffix=".so")
+    os.close(fd)
+    return path
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -54,7 +84,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         stale = (not have_so
                  or (os.path.exists(_SRC)
                      and os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)))
-        path = _build() if stale else _LIB_PATH
+        path = _build(_LIB_PATH) if stale else _LIB_PATH
         if path is None and have_so:
             path = _LIB_PATH        # no compiler: try the prebuilt .so
         if path is None:
@@ -63,16 +93,33 @@ def get_lib() -> Optional[ctypes.CDLL]:
         try:
             lib = _bind(ctypes.CDLL(path))
         except (OSError, AttributeError):
-            # cached .so predates a symbol we now need -> rebuild once
-            path = _build()
-            if path is None:
-                _build_failed = True
-                return None
+            # the .so at the canonical path is stale or corrupt AND this
+            # process may already have it mapped — rebuild under a fresh
+            # filename and load THAT (see _fresh_lib_path); also repair
+            # the canonical path for future processes
+            fresh = _fresh_lib_path()
             try:
-                lib = _bind(ctypes.CDLL(path))
-            except (OSError, AttributeError):
-                _build_failed = True
-                return None
+                path = _build(fresh)
+                if path is None:
+                    _build_failed = True
+                    return None
+                try:
+                    lib = _bind(ctypes.CDLL(path))
+                except (OSError, AttributeError):
+                    _build_failed = True
+                    return None
+                try:  # future processes get the good build here
+                    import shutil
+                    shutil.copy(path, _LIB_PATH + f".tmp.{os.getpid()}")
+                    os.replace(_LIB_PATH + f".tmp.{os.getpid()}",
+                               _LIB_PATH)
+                except OSError:
+                    pass
+            finally:
+                try:  # a live mapping keeps its inode; drop the dirent
+                    os.unlink(fresh)
+                except OSError:
+                    pass
         _lib = lib
         return _lib
 

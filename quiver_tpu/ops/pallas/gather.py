@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..._compat import pallas_tpu_compiler_params as _compiler_params
+
 # rows of the output processed by one grid step
 _BLOCK_ROWS = 256
 _N_BUF = 4
@@ -101,7 +103,7 @@ def gather_rows(feat: jax.Array, ids: jax.Array,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((padded, dim), feat.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(has_side_effects=True),
     )(ids.astype(jnp.int32), feat)
     return out[:b, :out_dim]
 

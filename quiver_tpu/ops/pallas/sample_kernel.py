@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..._compat import pallas_tpu_compiler_params as _compiler_params
+
 BLOCK = 128
 # lane alignment for HBM DMA starts; the staging window is
 # row_cap + ALIGN wide everywhere (pad, kernel, scratch) — keep in sync
@@ -192,7 +194,7 @@ def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
             pltpu.SemaphoreType.DMA((BLOCK,)),
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(has_side_effects=True),
     )(aligned,
       meta,
       jnp.asarray(seed, jnp.int32).reshape(1),

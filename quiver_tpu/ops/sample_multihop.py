@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from .sample import (LayerSample, as_index_rows, as_index_rows_overlapping,
                      compact_layer, edge_rows, permute_csr, sample_layer,
                      sample_layer_exact_wide, sample_layer_rotation,
-                     sample_layer_window)
+                     sample_layer_window, suggest_hub_cap)
 from .weighted import sample_layer_weighted, sample_layer_weighted_window
 
 
@@ -25,6 +25,7 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                     indices_stride: int | None = None,
                     seeds_dense: bool = False,
                     weight_rows: jax.Array | None = None,
+                    hub_frac: float | None = None,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
@@ -70,6 +71,12 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     a ``compact_ids`` output) — drops one operand from hop 0's
     compaction sort. Hops >= 1 always take that path (their seeds are
     the previous hop's ``n_id``, valid-first by construction).
+
+    ``hub_frac`` (static float, ``ExactBucketMeta.frac`` from
+    ``CSRTopo.exact_bucket_meta()``) sizes each hop's wide-exact
+    scattered-load budget from the graph's cached degree-bucket split
+    instead of the blind bs//2 default — only consumed by the exact
+    wide-fetch path; ignored elsewhere.
 
     ``eid`` enables per-edge id tracking (off by default — it adds one
     scattered gather per sampled edge, which the fused training path
@@ -154,10 +161,13 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
         elif indices_rows is not None:
             # exact + rows layout = the wide-fetch exact draw (same
             # contract as sample_layer, fewer scattered loads); the
-            # rows view MUST be of the same un-shuffled ``indices``
+            # rows view MUST be of the same un-shuffled ``indices``.
+            # The hub budget is static per hop: frontier width is a
+            # compile-time shape and hub_frac is cached graph metadata
             out = sample_layer_exact_wide(
                 indptr, indices, indices_rows, cur, k, sub,
-                stride=indices_stride, with_slots=track_eid)
+                stride=indices_stride, with_slots=track_eid,
+                hub_cap=suggest_hub_cap(int(cur.shape[0]), hub_frac))
         else:
             out = sample_layer(indptr, indices, cur, k, sub,
                                with_slots=track_eid)

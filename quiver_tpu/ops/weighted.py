@@ -43,8 +43,13 @@ def sample_layer_weighted(indptr: jax.Array, indices: jax.Array,
     offs = jnp.arange(row_cap, dtype=jnp.int32)[None, :]       # [1, cap]
     slot = jnp.clip(start[:, None] + offs, 0, e - 1)
     in_row = offs < pool[:, None]
+    # clamp negatives BEFORE the cumsum: both host engines do
+    # (cpu_sampler.cpp, _numpy_sample_layer_weighted), and a negative
+    # entry would make the CDF non-monotone — device and host batches
+    # must share one draw distribution (MixedGraphSageSampler contract)
     w_row = jnp.where(in_row,
-                      weights[slot].astype(jnp.float32), 0.0)  # [bs, cap]
+                      jnp.maximum(weights[slot].astype(jnp.float32), 0.0),
+                      0.0)                                     # [bs, cap]
     cdf = jnp.cumsum(w_row, axis=1)                            # row-local
     total = cdf[:, -1]                                         # [bs]
 
@@ -115,7 +120,9 @@ def sample_layer_weighted_window(indptr: jax.Array,
     cap = jnp.minimum(deg, win - off)                       # [bs]
     wiota = jax.lax.broadcasted_iota(jnp.int32, (1, win), 1)
     in_seg = (wiota >= off[:, None]) & (wiota < (off + cap)[:, None])
-    w_row = jnp.where(in_seg, w_wts.astype(jnp.float32), 0.0)
+    # negative weights clamped like the exact pool draw / host engines
+    w_row = jnp.where(in_seg,
+                      jnp.maximum(w_wts.astype(jnp.float32), 0.0), 0.0)
     cdf = jnp.cumsum(w_row, axis=1)                         # [bs, win]
     total = cdf[:, -1]
 

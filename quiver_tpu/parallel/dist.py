@@ -27,7 +27,7 @@ from __future__ import annotations
 from typing import Callable, Sequence
 
 import jax
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm import dist_lookup_local
@@ -42,7 +42,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                           loss_fn: Callable = cross_entropy_logits,
                           method: str = "exact",
                           indices_stride: int | None = None,
-                          with_replicate: bool = False):
+                          with_replicate: bool = False,
+                          hub_frac: float | None = None):
     """fn(state, spmd_feat, g2h, g2l, indptr, indices, seeds, labels,
     key[, indices_rows][, is_rep, rep_rank, bases]) -> (state, loss).
 
@@ -83,7 +84,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                 lambda p: _fused_loss(model, loss_fn, sizes, per_host_batch,
                                       p, feat, None, indptr, indices, seeds,
                                       labels, key, method, rows,
-                                      indices_stride, gather=gather)
+                                      indices_stride, gather=gather,
+                                      hub_frac=hub_frac)
             )(state.params)
             return _pmean_update(state, tx, grads, loss, axis)
 

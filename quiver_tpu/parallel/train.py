@@ -21,7 +21,7 @@ from typing import Any, Callable, NamedTuple, Sequence
 import jax
 import jax.numpy as jnp
 import optax
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.sample_multihop import sample_multihop
@@ -66,7 +66,8 @@ def masked_feature_gather(feat: jax.Array, n_id: jax.Array,
 
 def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
                 indptr, indices, seeds, labels, key, method="exact",
-                indices_rows=None, indices_stride=None, gather=None):
+                indices_rows=None, indices_stride=None, gather=None,
+                hub_frac=None):
     """``gather(feat, n_id, forder)`` defaults to the local
     ``masked_feature_gather``; the multi-host fused step substitutes the
     partitioned all_to_all lookup. Everything else (sampling keys, the
@@ -81,7 +82,7 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
     n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
                                    method=method, indices_rows=indices_rows,
                                    indices_stride=indices_stride,
-                                   seeds_dense=True)
+                                   seeds_dense=True, hub_frac=hub_frac)
     x = (gather or masked_feature_gather)(feat, n_id, forder)
     adjs = layers_to_adjs(layers, batch_size, sizes)
     logits = model.apply(params, x, adjs, train=True,
@@ -119,7 +120,8 @@ def _pmean_update(state, tx, grads, loss, axis):
 def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                      loss_fn: Callable = cross_entropy_logits,
                      method: str = "exact",
-                     indices_stride: int | None = None):
+                     indices_stride: int | None = None,
+                     hub_frac: float | None = None):
     """Single-chip fused step:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]). With ``method="rotation"`` pass the shuffled
@@ -127,7 +129,10 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
     ``reshuffle_csr`` — exact sort or cheap butterfly) — or, with
     ``indices_stride=128``, the
     ``as_index_rows_overlapping`` view (one row gather per seed, 2x
-    index memory)."""
+    index memory). With ``method="exact"`` + an un-shuffled layout view
+    as ``indices_rows``, pass ``hub_frac`` (the cached
+    ``CSRTopo.exact_bucket_meta().frac``) so the wide-exact hub budget
+    is sized from the graph's degree-bucket split."""
     sizes = list(sizes)
 
     @jax.jit
@@ -136,7 +141,8 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
         loss, grads = jax.value_and_grad(
             lambda p: _fused_loss(model, loss_fn, sizes, batch_size, p, feat,
                                   forder, indptr, indices, seeds, labels, key,
-                                  method, indices_rows, indices_stride)
+                                  method, indices_rows, indices_stride,
+                                  hub_frac=hub_frac)
         )(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -150,14 +156,17 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                          axis: str = "data",
                          loss_fn: Callable = cross_entropy_logits,
                          method: str = "exact",
-                         indices_stride: int | None = None):
+                         indices_stride: int | None = None,
+                         hub_frac: float | None = None):
     """Data-parallel fused step over ``mesh[axis]``:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]) with seeds/labels [n_dev * per_device_batch] sharded
     over ``axis``; state/feat/topology (and the shuffled rows view when
     ``method="rotation"``) replicated; grads pmean over ``axis``.
     ``indices_stride=128`` switches ``indices_rows`` to the
-    ``as_index_rows_overlapping`` layout (one row gather per seed)."""
+    ``as_index_rows_overlapping`` layout (one row gather per seed).
+    ``hub_frac`` (cached ``CSRTopo.exact_bucket_meta().frac``) sizes the
+    wide-exact hub budget when exact mode gets an ``indices_rows``."""
     sizes = list(sizes)
 
     def per_shard(state: TrainState, feat, forder, indptr, indices, seeds,
@@ -167,7 +176,7 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
             lambda p: _fused_loss(model, loss_fn, sizes, per_device_batch, p,
                                   feat, forder, indptr, indices, seeds,
                                   labels, key, method, indices_rows,
-                                  indices_stride)
+                                  indices_stride, hub_frac=hub_frac)
         )(state.params)
         return _pmean_update(state, tx, grads, loss, axis)
 
@@ -205,7 +214,8 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
 def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                            loss_fn: Callable = cross_entropy_logits,
                            method: str = "exact",
-                           indices_stride: int | None = None):
+                           indices_stride: int | None = None,
+                           hub_frac: float | None = None):
     """Two-phase step for tiered feature stores (the reference's own
     architecture: sampling and feature collection run as separate stages
     around the model, examples/pyg/reddit_quiver.py:116-122):
@@ -227,7 +237,7 @@ def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
             indptr, indices, seeds, sizes, key, method=method,
             indices_rows=indices_rows,
             indices_stride=indices_stride if indices_rows is not None
-            else None, seeds_dense=True)
+            else None, seeds_dense=True, hub_frac=hub_frac)
         return n_id, layers_to_adjs(layers, batch_size, sizes)
 
     @jax.jit

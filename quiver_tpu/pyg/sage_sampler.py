@@ -372,11 +372,22 @@ class GraphSageSampler:
                     next(it) if a is not None else None for a in arrays]
         self._rot = rows
 
+    def _exact_hub_frac(self):
+        """Static hub fraction sizing the wide-exact scattered-load
+        budget — the degree-bucket split computed once per graph and
+        cached on the topology (CSRTopo.exact_bucket_meta); None when
+        the wide-fetch exact path is not in play."""
+        if self.sampling != "exact" or not self.wide_exact \
+                or self.edge_weight is not None or self.mode == "CPU":
+            return None
+        return float(self.csr_topo.exact_bucket_meta(step=128).frac)
+
     # -- core ---------------------------------------------------------------
     def _build_fn(self, batch_size: int):
         sizes = self.sizes
         weighted = self.edge_weight is not None
         method = self.sampling
+        hub_frac = self._exact_hub_frac()
         eid_mode = "none"
         if self.with_eid:
             # rotation/window always need the co-permuted map; otherwise
@@ -397,7 +408,7 @@ class GraphSageSampler:
                                    eid=eid,
                                    indices_stride=stride if rows is not None
                                    else None,
-                                   weight_rows=w_rows)
+                                   weight_rows=w_rows, hub_frac=hub_frac)
 
         return jax.jit(run)
 

@@ -108,6 +108,7 @@ class CSRTopo:
         else:
             raise ValueError("provide either edge_index or indptr+indices")
         self._feature_order = None
+        self._bucket_meta = {}   # {step: ExactBucketMeta}, lazy
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
@@ -118,6 +119,7 @@ class CSRTopo:
     def tree_unflatten(cls, aux, leaves):
         obj = cls.__new__(cls)
         obj._indptr, obj._indices, obj._eid, obj._feature_order = leaves
+        obj._bucket_meta = {}
         return obj
 
     # -- accessors ----------------------------------------------------------
@@ -153,6 +155,21 @@ class CSRTopo:
     def edge_count(self) -> int:
         return int(self._indices.shape[0])
 
+    def exact_bucket_meta(self, step: int = 128):
+        """Degree-bucket split for the wide-fetch exact sampler
+        (``ops.sample.ExactBucketMeta``): hub-mass fractions that size
+        the static scattered-load budget (``suggest_hub_cap``). Computed
+        once per row-layout ``step`` and cached — the homogeneous
+        sampler, every hetero relation, and the fused train step all
+        read the same cached split, so the multi-hop program's shapes
+        are decided once per graph, not per epoch."""
+        meta = self._bucket_meta.get(step)
+        if meta is None:
+            from ..ops.sample import exact_bucket_meta
+            meta = exact_bucket_meta(self._indptr, step=step)
+            self._bucket_meta[step] = meta
+        return meta
+
     def share_memory_(self):
         return self
 
@@ -179,6 +196,7 @@ class CSRTopo:
         obj._indices = put(self._indices)
         obj._eid = put(self._eid)
         obj._feature_order = put(self._feature_order)
+        obj._bucket_meta = dict(self._bucket_meta)  # placement-independent
         return obj
 
     def __repr__(self):

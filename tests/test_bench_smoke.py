@@ -1,0 +1,45 @@
+"""CPU smoke invocation of the official bench harness (tier-1).
+
+The TPU tunnel can be down for whole rounds; this keeps bench.py itself
+— argument parsing, the epoch program, the JSON contract, the per-mode
+SEPS keys — regression-tested on every CI run at a reduced scale, so a
+bench breakage surfaces as a test failure instead of a lost round.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_cpu_smoke_json_contract():
+    env = dict(os.environ)
+    env.update({
+        "QT_BENCH_PLATFORM": "cpu",
+        # smallest honest scale: one rotation arm (pair+sort), two
+        # batches — proves the harness runs, not a comparable number
+        "QT_BENCH_NODES": "40000",
+        "QT_BENCH_AVG_DEG": "8",
+        "QT_BENCH_BATCHES": "2",
+        "QT_BENCH_BATCH": "256",
+        "QT_BENCH_LAYOUT": "pair",
+        "QT_BENCH_SHUFFLE": "sort",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout          # ONE JSON line
+    out = json.loads(lines[0])
+    assert out["platform"] == "cpu-smoke"
+    assert out["unit"] == "edges/s"
+    assert out["value"] and out["value"] > 0
+    # per-mode SEPS tracked by the official metric (exact-mode gap)
+    for mode in ("rotation", "exact", "window"):
+        assert out[f"{mode}_mode_value"] > 0
+        assert out[f"{mode}_mode_vs_baseline"] is None   # not comparable
+    assert out["vs_baseline"] is None
+    assert "error" not in out

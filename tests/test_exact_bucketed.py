@@ -1,0 +1,216 @@
+"""Degree-bucketed exact sampling: distribution parity with the jnp
+oracle across the low/hub bucket boundary, edge-id survival through the
+bucket dispatch (homogeneous + hetero), and the cached bucket-split
+metadata that sizes the static hub budget."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import quiver_tpu as qv
+from quiver_tpu.hetero import HeteroCSRTopo, HeteroGraphSageSampler
+from quiver_tpu.ops import (as_index_rows, exact_bucket_meta,
+                            sample_layer, sample_layer_exact_wide,
+                            sample_multihop, suggest_hub_cap)
+
+KEY = jax.random.key(7)
+
+
+def boundary_graph():
+    """Rows that straddle the low/hub split in both ways the classifier
+    can: node 0 and node 1 have the SAME degree (250) but different
+    window alignment (start 0 vs start 250 -> off 122), so 0 is low and
+    1 is a hub; node 2 is low by degree (10), node 3 a hub by degree
+    (400 > window). Neighbor ids land on zero-degree tail nodes so the
+    graph is closed under multi-hop expansion."""
+    degs = [250, 250, 10, 400]
+    n_nodes = 4400 + 400        # probe rows + zero-degree neighbor tail
+    indptr = np.zeros(n_nodes + 1, np.int64)
+    np.cumsum(degs, out=indptr[1:len(degs) + 1])
+    indptr[len(degs) + 1:] = indptr[len(degs)]
+    blocks = [1000 + np.arange(250), 2000 + np.arange(250),
+              3000 + np.arange(10), 4000 + np.arange(400)]
+    indices = np.concatenate(blocks).astype(np.int64)
+    return indptr, indices, blocks
+
+
+class TestBucketMeta:
+    def test_fractions_on_boundary_graph(self):
+        # the probe prefix alone: hubs are node 1 (alignment) and
+        # node 3 (degree) of 4 rows
+        indptr = np.array([0, 250, 500, 510, 910], np.int64)
+        meta = exact_bucket_meta(indptr)
+        assert meta.node_frac == 2 / 4
+        np.testing.assert_allclose(meta.edge_frac, (250 + 400) / 910)
+        assert meta.frac == max(meta.node_frac, meta.edge_frac)
+
+    def test_csr_topo_caches(self):
+        indptr, indices, _ = boundary_graph()
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        a = topo.exact_bucket_meta()
+        b = topo.exact_bucket_meta()
+        assert a is b                      # computed once, cached
+        # a device-put copy carries the cache (placement-independent)
+        assert topo.device_put(jax.devices()[0]) \
+            .exact_bucket_meta() == a
+
+    def test_suggest_hub_cap(self):
+        assert suggest_hub_cap(1024, None) is None      # default budget
+        cap = suggest_hub_cap(1024, 0.1)
+        assert cap == int(np.ceil(1024 * 0.3)) + 64     # 3x + floor
+        assert suggest_hub_cap(1024, 1.0) == 1024       # never past bs
+        assert suggest_hub_cap(8, 0.01) == 8            # floor clamps
+
+    def test_jnp_indptr_matches_numpy(self):
+        indptr, _, _ = boundary_graph()
+        a = exact_bucket_meta(indptr)
+        b = exact_bucket_meta(jnp.asarray(indptr, jnp.int32))
+        np.testing.assert_allclose(
+            [a.node_frac, a.edge_frac], [b.node_frac, b.edge_frac])
+
+
+def _chi2_uniform(counts):
+    exp = counts.sum() / counts.shape[0]
+    return float(((counts - exp) ** 2 / exp).sum())
+
+
+class TestBoundaryDistribution:
+    def test_chi_square_matches_oracle_across_split(self):
+        # per node (two of them straddling the bucket split at the SAME
+        # degree), the wide sampler's neighbor marginal must be uniform
+        # — the jnp scattered draw (sample_layer) is the distribution
+        # ground truth and is held to the identical chi-square bar
+        indptr, indices, blocks = boundary_graph()
+        meta = exact_bucket_meta(indptr)
+        ip, ix = jnp.asarray(indptr), jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        seeds = jnp.asarray(np.tile(np.arange(4), 128).astype(np.int32))
+        hub_cap = suggest_hub_cap(int(seeds.shape[0]), meta.frac)
+        k = 3
+        wide = jax.jit(lambda ky: sample_layer_exact_wide(
+            ip, ix, rows, seeds, k, ky, hub_cap=hub_cap))
+        oracle = jax.jit(lambda ky: sample_layer(ip, ix, seeds, k, ky))
+        hits = {"wide": np.zeros(910), "oracle": np.zeros(910)}
+        for t in range(20):
+            sk = jax.random.fold_in(KEY, t)
+            for name, fn in (("wide", wide), ("oracle", oracle)):
+                nbrs = np.asarray(fn(sk)[0]).ravel()
+                ids, cnt = np.unique(nbrs[nbrs >= 0], return_counts=True)
+                np.add.at(hits[name],
+                          np.searchsorted(indices, ids), cnt)
+        offs = np.cumsum([0] + [len(b) for b in blocks])
+        for name in ("wide", "oracle"):
+            for v in range(4):
+                counts = hits[name][offs[v]:offs[v + 1]]
+                df = len(counts) - 1
+                # ~5 sigma of the chi-square's sqrt(2 df) spread
+                bound = df + 5.0 * np.sqrt(2 * df)
+                assert _chi2_uniform(counts) < bound, (name, v)
+
+    def test_same_degree_rows_same_marginal(self):
+        # nodes 0 (low) and 1 (hub) have equal degree (250): their
+        # per-position empirical marginals must agree with EACH OTHER,
+        # not just with uniform — a bucket-specific bias shows here
+        # first. Two-sample chi-square homogeneity over the 250
+        # positions, ~5 sigma bound.
+        indptr, indices, _ = boundary_graph()
+        ip, ix = jnp.asarray(indptr), jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        seeds = jnp.asarray(np.tile([0, 1], 256).astype(np.int32))
+        fn = jax.jit(lambda ky: sample_layer_exact_wide(
+            ip, ix, rows, seeds, 4, ky, hub_cap=320))
+        h = np.zeros((2, 250))
+        for t in range(20):
+            nbrs = np.asarray(fn(jax.random.fold_in(KEY, 100 + t))[0])
+            for side, base in ((0, 1000), (1, 2000)):
+                got = nbrs[side::2].ravel()
+                got = got[got >= 0] - base
+                np.add.at(h[side], got, 1)
+        assert h[0].sum() == h[1].sum() == 256 * 4 * 20
+        chi2 = float(((h[0] - h[1]) ** 2 / (h[0] + h[1])).sum())
+        df = 249
+        assert chi2 < df + 5.0 * np.sqrt(2 * df)
+
+
+class TestEidThroughBuckets:
+    def test_homogeneous_multihop_slots_and_map(self):
+        indptr, indices, _ = boundary_graph()
+        ip, ix = jnp.asarray(indptr), jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        seeds = jnp.asarray(np.arange(4, dtype=np.int32))
+        meta = exact_bucket_meta(indptr)
+        n_id, layers = sample_multihop(
+            ip, ix, seeds, [4, 3], KEY, method="exact", indices_rows=rows,
+            eid=True, hub_frac=meta.frac)
+        n_id = np.asarray(n_id)
+        for lay in layers:
+            nid = np.asarray(lay.n_id)
+            row, col = np.asarray(lay.row), np.asarray(lay.col)
+            e_id = np.asarray(lay.e_id)
+            m = col >= 0
+            assert (e_id[m] >= 0).all() and (e_id[~m] == -1).all()
+            for r, c, s in zip(row[m], col[m], e_id[m]):
+                seed_g, nbr_g = nid[r], nid[c]
+                # the slot lies in the seed's CSR segment and stores
+                # the sampled neighbor — for low AND hub rows alike
+                assert indptr[seed_g] <= s < indptr[seed_g + 1]
+                assert indices[s] == nbr_g
+        # an eid MAP rides the same slots: eid=perm must equal perm[slot]
+        perm = np.random.default_rng(3).permutation(len(indices))
+        _, layers_map = sample_multihop(
+            ip, ix, seeds, [4, 3], KEY, method="exact", indices_rows=rows,
+            eid=jnp.asarray(perm.astype(np.int32)), hub_frac=meta.frac)
+        for lay, lay_m in zip(layers, layers_map):
+            s, sm = np.asarray(lay.e_id), np.asarray(lay_m.e_id)
+            m = s >= 0
+            np.testing.assert_array_equal(sm[m], perm[s[m]])
+            np.testing.assert_array_equal(sm[~m], -1)
+
+    def test_hetero_adjs_carry_slots_across_buckets(self):
+        # one relation whose rows span both buckets: d0 is a 300-deg
+        # hub, d1/d2 low; e_id must be the pick's CSR slot in every case
+        degs = [300, 5, 0]
+        indptr = np.zeros(4, np.int64)
+        np.cumsum(degs, out=indptr[1:])
+        rng = np.random.default_rng(0)
+        indices = rng.integers(0, 500, int(indptr[-1]))
+        et = ("s", "r", "d")
+        topo = HeteroCSRTopo(
+            {et: qv.CSRTopo(indptr=indptr, indices=indices)},
+            {"s": 500, "d": 3})
+        sampler = HeteroGraphSageSampler(
+            topo, sizes=[4], seed_type="d", with_eid=True)
+        seeds = np.arange(3, dtype=np.int64)
+        frontier, _, layers = sampler.sample(seeds)
+        assert sampler._hub_fracs is not None      # split cached + used
+        adj = layers[0].adjs[et]
+        src = np.asarray(adj.edge_index[0])
+        dst = np.asarray(adj.edge_index[1])
+        e_id = np.asarray(adj.e_id)
+        f = np.asarray(layers[0].frontier["s"])
+        m = np.asarray(adj.mask)
+        assert m.sum() == 4 + 4                    # d0 and d1 rows draw
+        assert (e_id[~m] == -1).all()
+        for s_l, d_pos, slot in zip(src[m], dst[m], e_id[m]):
+            dst_g = seeds[d_pos]
+            assert indptr[dst_g] <= slot < indptr[dst_g + 1]
+            assert indices[slot] == f[s_l]
+
+
+class TestBudgetOverflowParity:
+    def test_tiny_budget_still_exact(self):
+        # hub_frac metadata under-estimating (budget 1) must degrade to
+        # the cond full-scatter, never to a wrong draw
+        indptr, indices, _ = boundary_graph()
+        ip, ix = jnp.asarray(indptr), jnp.asarray(indices)
+        rows = as_index_rows(ix)
+        seeds = jnp.asarray(np.array([1, 3, 1, 3], np.int32))  # all hubs
+        nbrs, counts = sample_layer_exact_wide(
+            ip, ix, rows, seeds, 5, KEY, hub_cap=1)
+        nbrs, counts = np.asarray(nbrs), np.asarray(counts)
+        assert (counts == 5).all()
+        for i, v in enumerate([1, 3, 1, 3]):
+            got = nbrs[i][:5]
+            lo, hi = indptr[v], indptr[v + 1]
+            assert set(got.tolist()) <= set(indices[lo:hi].tolist())
+            assert len(set(got.tolist())) == 5

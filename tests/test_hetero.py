@@ -200,24 +200,36 @@ class TestHeteroPerfModes:
         assert checked > 0
 
     def test_rotation_marginal_uniform_across_reshuffles(self, rng):
-        # single relation, one dst node with 12 src neighbors, k=2:
-        # rotation + per-epoch reshuffle must hit each neighbor ~1/6
-        indptr = np.array([0, 12])
-        indices = np.arange(12)
+        # single relation, 64 dst nodes each with the same 12 src
+        # neighbors, k=2: rotation + per-epoch reshuffle must hit each
+        # neighbor ~1/12. Counting the relation's EDGES (the frontier
+        # union would collapse duplicate draws across rows) gives
+        # 64 rows x 2 draws x 60 epochs = 7680 samples: per-bin sigma
+        # ~0.0031, so the 0.02 tolerance sits at ~6 sigma — calibrated
+        # (the old 1-row/120-draw form failed at ~1.4 sigma), while
+        # still far below the ~0.038 endpoint-bias a broken (never
+        # reshuffled) rotation would show
+        n_dst, deg = 64, 12
+        indptr = np.arange(n_dst + 1) * deg
+        indices = np.tile(np.arange(deg), n_dst)
+        et = ("s", "r", "d")
         topo = HeteroCSRTopo(
-            {("s", "r", "d"): qv.CSRTopo(indptr=indptr, indices=indices)},
-            {"s": 12, "d": 1})
+            {et: qv.CSRTopo(indptr=indptr, indices=indices)},
+            {"s": deg, "d": n_dst})
         sampler = HeteroGraphSageSampler(
             topo, sizes=[2], seed_type="d", sampling="rotation")
-        hits = np.zeros(12)
+        hits = np.zeros(deg)
         for epoch in range(60):
             sampler.reshuffle()
-            frontier, _, layers = sampler.sample(np.zeros(1, np.int64))
+            frontier, _, layers = sampler.sample(
+                np.arange(n_dst, dtype=np.int64))
+            adj = layers[0].adjs[et]
             f = np.asarray(layers[0].frontier["s"])
-            for v in f[f >= 0]:
+            src = np.asarray(adj.edge_index[0])
+            for v in f[src[src >= 0]]:
                 hits[v] += 1
         freq = hits / hits.sum()
-        np.testing.assert_allclose(freq, 1 / 12, atol=0.035)
+        np.testing.assert_allclose(freq, 1 / deg, atol=0.02)
 
     def test_frontier_cap_truncates_and_masks(self, mag_like, rng):
         cap = 24

@@ -210,6 +210,43 @@ class TestWeightedLayer:
         assert int(np.asarray(counts).sum()) == 0
         assert (np.asarray(nbrs) == -1).all()
 
+    def test_negative_weights_clamped_like_host_engines(self):
+        # both host engines clamp negatives before the CDF
+        # (cpu_sampler.cpp, _numpy_sample_layer_weighted); the device
+        # path must share the distribution — a negative entry acts as
+        # zero mass, never as a non-monotone CDF glitch
+        indptr = jnp.asarray(np.array([0, 4]))
+        indices = jnp.asarray(np.array([10, 20, 30, 40]))
+        w = jnp.asarray(np.array([-5.0, 1.0, -0.5, 1.0], np.float32))
+        seeds = jnp.zeros((512,), jnp.int32)
+        hits = np.zeros(5)
+        for t in range(5):
+            nbrs, counts = sample_layer_weighted(
+                indptr, indices, w, seeds, 2, jax.random.fold_in(KEY, t))
+            ids, cnt = np.unique(np.asarray(nbrs) // 10,
+                                 return_counts=True)
+            np.add.at(hits, ids[ids >= 0], cnt[ids >= 0])
+            assert (np.asarray(counts) == 2).all()
+        assert hits[1] == hits[3] == 0          # negative-weight edges
+        np.testing.assert_allclose(hits[2] / hits.sum(), 0.5, atol=0.05)
+
+    def test_negative_weights_clamped_windowed(self):
+        indptr = np.array([0, 4])
+        indices = np.arange(4, dtype=np.int32)
+        w = np.array([-3.0, 2.0, -1.0, 2.0], np.float32)
+        seeds = jnp.zeros((512,), jnp.int32)
+        hits = np.zeros(4)
+        for t in range(5):
+            irows, wrows, stride = _window_setup(
+                indptr, indices, w, jax.random.key(90 + t))
+            nbrs, _ = sample_layer_weighted_window(
+                jnp.asarray(indptr), irows, wrows, seeds, 2,
+                jax.random.fold_in(KEY, t), stride=stride)
+            ids, cnt = np.unique(np.asarray(nbrs), return_counts=True)
+            np.add.at(hits, ids[ids >= 0], cnt[ids >= 0])
+        assert hits[0] == hits[2] == 0
+        np.testing.assert_allclose(hits[1] / hits.sum(), 0.5, atol=0.05)
+
     def test_eid_alignment(self, rng):
         # COO weights reordered into CSR slot order through CSRTopo.eid
         n, e = 30, 200
