@@ -15,12 +15,20 @@ Modes:
                (stage batch i+1's host rows while batch i transfers) —
                the double-buffered path a training loop uses
 
+  --ab-dedup   duplicate-heavy frontier A/B: the fused tiered lookup
+               with dedup_cold off vs on, masked off vs on, on the SAME
+               ids — reports gathered-rows/sec and host bytes moved per
+               arm (the bandwidth half of the paper: host traffic per
+               unique cold node, not per frontier slot). --dup sets the
+               duplicate factor (batch / distinct ids).
+
 Usage: python benchmarks/bench_feature.py [--rows N] [--dim D]
        [--batch B] [--iters K] [--pallas] [--bf16]
-       [--tiered F] [--prefetch]
+       [--tiered F] [--prefetch] [--ab-dedup] [--dup F]
 """
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -28,6 +36,107 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+
+def run_ab_dedup(args, jax, jnp):
+    """Dedup A/B on a duplicate-heavy (multi-hop-frontier-shaped)
+    cold-tier workload: same feature table, same id streams, fused
+    tiered lookup with dedup_cold {off, on} x masked {off, on}."""
+    import quiver_tpu as qv
+
+    rng = np.random.default_rng(0)
+    rows, dim, batch, iters = args.rows, args.dim, args.batch, args.iters
+    frac = args.tiered if args.tiered is not None else 0.25
+    dup = max(args.dup, 1.0)
+    feat = rng.standard_normal((rows, dim)).astype(np.float32)
+    row_bytes = dim * feat.dtype.itemsize
+    cache_rows = int(rows * frac)
+
+    # frontier-shaped ids: each batch draws `batch` slots from a small
+    # per-batch pool of distinct nodes (hub revisits across hops)
+    ids_np, masked_np = [], []
+    for i in range(iters):
+        pool = rng.choice(rows, size=max(int(batch / dup), 1),
+                          replace=False)
+        ids = pool[rng.integers(0, pool.size, batch)]
+        ids_np.append(ids.astype(np.int64))
+        m = ids.astype(np.int64).copy()
+        # frontier-shaped padding: static multi-hop caps run well past
+        # the realized frontier, so a third or more of the slots are -1
+        # (layer_shapes caps vs realized uniques on power-law graphs)
+        m[rng.random(batch) < args.pad] = -1
+        masked_np.append(m)
+
+    def host_rows_read(ids, dedup, budget):
+        """Analytic host-tier rows read per batch for the path taken
+        (mirrors lookup_tiered's branch structure: the dedup overflow
+        predicate is the unique count of the WHOLE valid frontier, hot
+        and cold, not just the cold slots)."""
+        valid = ids >= 0
+        cold = valid & (ids >= cache_rows)
+        if budget >= batch:
+            return batch
+        need = (np.unique(ids[valid]).size if dedup
+                else int(cold.sum()))
+        return budget if need <= budget else batch
+
+    budget = max(batch // 4, 256)                 # lookup default
+    stores = {}
+    for dedup in (False, True):
+        f = qv.Feature(device_cache_size=cache_rows * row_bytes,
+                       dedup_cold=dedup)
+        f.from_cpu_tensor(feat)
+        stores[dedup] = (f, jnp.asarray(f.host_part))
+
+    out = {}
+    for masked in (False, True):
+        stream = masked_np if masked else ids_np
+        ids_dev = [jnp.asarray(a) for a in stream]
+        # the arms are timed INTERLEAVED per batch (naive then dedup on
+        # the same ids) so machine-load drift across the run cancels
+        # out of the A/B ratio instead of landing on one arm
+        elapsed = {False: 0.0, True: 0.0}
+        for dedup in (False, True):               # compile both
+            f, host = stores[dedup]
+            jax.block_until_ready(f._lookup_tiered(
+                f.device_part, host, ids_dev[0], f.feature_order,
+                masked))
+        for it, ids in enumerate(ids_dev):
+            # alternate which arm goes first: the second arm reads the
+            # batch's pool rows cache-warm, a systematic bias that
+            # would otherwise always favor one side
+            order = (False, True) if it % 2 == 0 else (True, False)
+            for dedup in order:
+                f, host = stores[dedup]
+                t0 = time.perf_counter()
+                jax.block_until_ready(f._lookup_tiered(
+                    f.device_part, host, ids, f.feature_order, masked))
+                elapsed[dedup] += time.perf_counter() - t0
+        for dedup in (False, True):
+            host_bytes = sum(host_rows_read(a, dedup, budget)
+                             for a in stream) * row_bytes
+            key = (f"dedup={'on' if dedup else 'off'} "
+                   f"masked={'on' if masked else 'off'}")
+            out[key] = {"rows_per_s": batch * iters / elapsed[dedup],
+                        "host_mb": host_bytes / 1e6}
+            print(f"[ab-dedup cache={frac:.0%} dup={dup:g} {key}] "
+                  f"{out[key]['rows_per_s'] / 1e6:.2f} Mrows/s, "
+                  f"host {out[key]['host_mb']:.1f} MB")
+    for f, _ in stores.values():
+        f.close()
+    for masked in ("off", "on"):
+        a = out[f"dedup=off masked={masked}"]
+        b = out[f"dedup=on masked={masked}"]
+        print(f"[ab-dedup masked={masked}] speedup "
+              f"{b['rows_per_s'] / a['rows_per_s']:.2f}x rows/s, "
+              f"host bytes {a['host_mb'] / max(b['host_mb'], 1e-9):.1f}x "
+              "less")
+    print(json.dumps({"bench": "ab_dedup", "rows": rows, "dim": dim,
+                      "batch": batch, "iters": iters, "dup": dup,
+                      "cache_frac": frac,
+                      "results": {k: {kk: round(vv, 1)
+                                      for kk, vv in v.items()}
+                                  for k, v in out.items()}}))
 
 
 def main():
@@ -49,11 +158,25 @@ def main():
                         "cold tier stays a pinned_host jax array and "
                         "the whole lookup fuses into one dispatch "
                         "(UVA-gather analogue; TPU/GPU only)")
+    p.add_argument("--ab-dedup", action="store_true",
+                   help="duplicate-heavy frontier A/B: fused tiered "
+                        "lookup, dedup on/off x masked on/off")
+    p.add_argument("--dup", type=float, default=8.0,
+                   help="with --ab-dedup: duplicate factor "
+                        "(batch / distinct ids per batch)")
+    p.add_argument("--pad", type=float, default=0.35,
+                   help="with --ab-dedup: -1 padding share of the "
+                        "masked stream (static frontier caps run well "
+                        "past realized uniques)")
     args = p.parse_args()
 
     from _common import configure_jax
     jax = configure_jax()
     import jax.numpy as jnp
+
+    if args.ab_dedup:
+        run_ab_dedup(args, jax, jnp)
+        return
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     key = jax.random.key(0)

@@ -38,6 +38,7 @@ from .async_sampler import AsyncNeighborSampler, AsyncCudaNeighborSampler
 from .debug import show_tensor_info
 from .inference import layerwise_inference
 from .datasets import GraphDataset, from_numpy_dir
+from .pipeline import Pipeline, pipelined
 from . import comm, profiling, checkpoint, datasets, debug
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
@@ -79,4 +80,6 @@ __all__ = [
     "AsyncCudaNeighborSampler",
     "show_tensor_info",
     "layerwise_inference",
+    "Pipeline",
+    "pipelined",
 ]

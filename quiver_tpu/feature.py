@@ -74,7 +74,8 @@ class Feature:
                  mesh: Optional[Mesh] = None,
                  dtype=None,
                  host_placement: str = "numpy",
-                 cold_budget: Optional[int] = None):
+                 cold_budget: Optional[int] = None,
+                 dedup_cold=False):
         if cache_policy not in ("device_replicate", "p2p_clique_replicate",
                                 "shard"):
             raise ValueError(f"unknown cache_policy {cache_policy!r}")
@@ -98,6 +99,15 @@ class Feature:
         # reads from the host tier (None = max(batch//4, 256)); see
         # _build_gather's lookup_tiered
         self.cold_budget = cold_budget
+        # dedup_cold: gather each UNIQUE cold node's host row once and
+        # inverse-scatter to frontier positions, so host-tier traffic
+        # scales with unique cold nodes, not frontier slots (multi-hop
+        # frontiers repeat hubs many times). True uses cold_budget (or
+        # its default) as the unique budget; an int sets the unique
+        # budget directly. Overflowing batches fall back to the full
+        # gather via lax.cond — exact in every case. Pays when the
+        # frontier duplicate factor exceeds ~1.3 (docs/api.md).
+        self.dedup_cold = dedup_cold
         self.feature_order = None      # old id -> storage row
         self.cache_rows = 0
         self.device_part = None        # jnp [cache_rows, dim]
@@ -137,6 +147,16 @@ class Feature:
                 tensor, new_order = reindex_feature(
                     self.csr_topo, tensor, 0)
                 self.csr_topo.feature_order = jnp.asarray(new_order)
+            else:
+                # a topo shared with an earlier store already carries
+                # the hot-order permutation: apply it to THIS tensor
+                # too, or the lookup indirection would read hot-order
+                # storage rows out of an unpermuted array
+                order = np.asarray(jax.device_get(
+                    self.csr_topo.feature_order))
+                storage = np.empty_like(tensor)
+                storage[order] = tensor
+                tensor = storage
             self.feature_order = jnp.asarray(self.csr_topo.feature_order,
                                              dtype=jnp.int32)
 
@@ -287,6 +307,10 @@ class Feature:
         self._lookup_cached_masked = jax.jit(lookup_cached_masked)
 
         cold_budget = self.cold_budget
+        dedup = bool(self.dedup_cold)
+        dedup_budget = (int(self.dedup_cold)
+                        if dedup and not isinstance(self.dedup_cold, bool)
+                        else None)
 
         def lookup_tiered(dev_part, host_part, ids, order, masked=False):
             # one dispatch for the WHOLE tiered lookup: hot rows from
@@ -296,9 +320,11 @@ class Feature:
             # path (tested); placement makes it UVA-like on TPU/GPU.
             #
             # Host-memory traffic scales with the MISS RATE, not the
-            # batch: cold positions are compacted (rank + sort, the
-            # sample_layer_exact_wide hub-budget pattern) and only a
-            # static ``budget`` of host rows is gathered — the
+            # batch — and with ``dedup_cold``, with the UNIQUE miss
+            # count (hub repeats in a multi-hop frontier collapse to
+            # one host read each): cold positions are compacted (rank +
+            # sort, the sample_layer_exact_wide hub-budget pattern) and
+            # only a static ``budget`` of host rows is gathered — the
             # reference's UVA kernel likewise touches only the rows it
             # needs (shard_tensor.cu.hpp:49-58). A batch whose cold
             # count exceeds the budget falls back via ``lax.cond`` to
@@ -327,38 +353,105 @@ class Feature:
             n = t.shape[0]
             cold_total = host_part.shape[0]
             cold_idx = jnp.clip(t - cache_rows, 0, max(cold_total - 1, 0))
+            budget = (dedup_budget if dedup_budget is not None
+                      else cold_budget if cold_budget is not None
+                      else max(n // 4, 256))
             if dev_part is None:
+                if dedup and budget < n:
+                    # no HBM cache: every slot is cold — dedup still
+                    # bounds the host read to unique rows
+                    from .ops.dedup import dedup_take
+                    return finish(dedup_take(host_part, cold_idx, budget))
                 return finish(jnp.take(host_part, cold_idx, axis=0))
-            hot_rows = gather_cached(dev_part, jnp.where(hot, t, 0))
 
-            budget = (max(n // 4, 256) if cold_budget is None
-                      else cold_budget)
-            if budget >= n:
-                # budget can't beat a full gather: keep the single
-                # unconditional host read (also the tiny-batch path)
-                cold_rows = jnp.take(host_part, cold_idx, axis=0)
-                return finish(jnp.where(hot[:, None], hot_rows, cold_rows))
-
-            cold = ~hot
-            n_cold = jnp.sum(cold).astype(jnp.int32)
-            iota = jnp.arange(n, dtype=jnp.int32)
-            crank = jnp.cumsum(cold).astype(jnp.int32) - 1
-            okey = jnp.where(cold & (crank < budget), crank,
-                             jnp.iinfo(jnp.int32).max)
-            _, cpos = jax.lax.sort((okey, iota), num_keys=1)
-            cpos = cpos[:budget]        # cold positions (garbage past n_cold)
-            c_valid = (jnp.arange(budget, dtype=jnp.int32)
-                       < jnp.minimum(n_cold, budget))
-            rows = jnp.take(host_part, cold_idx[cpos], axis=0)  # [budget, dim]
-            tgt = jnp.where(c_valid, cpos, n)                   # n = drop slot
-            narrow = hot_rows.at[tgt].set(rows, mode="drop")
-
-            def _full(_):
+            def naive_full():
+                hot_rows = gather_cached(dev_part, jnp.where(hot, t, 0))
                 cold_rows = jnp.take(host_part, cold_idx, axis=0)
                 return jnp.where(hot[:, None], hot_rows, cold_rows)
 
-            return finish(jax.lax.cond(n_cold > budget, _full,
-                                       lambda _: narrow, None))
+            if budget >= n:
+                # budget can't beat a full gather: keep the single
+                # unconditional host read (also the tiny-batch path)
+                return finish(naive_full())
+
+            def compacted_lookup():
+                """The cold-compaction narrow path: hot rows gathered
+                per slot, up to ``budget`` cold SLOTS scatter-filled
+                from the host tier, its own lax.cond full-gather
+                fallback when raw cold count overflows. The non-dedup
+                path runs this directly; the dedup path runs it as the
+                unique-overflow fallback so enabling dedup can never
+                move MORE host bytes than leaving it off (a hot-heavy
+                batch can overflow the unique budget while its cold
+                slots still fit the compaction budget)."""
+                hot_rows = gather_cached(dev_part, jnp.where(hot, t, 0))
+                cold = ~hot
+
+                def _full(_):
+                    cold_rows = jnp.take(host_part, cold_idx, axis=0)
+                    return jnp.where(hot[:, None], hot_rows, cold_rows)
+
+                n_cold = jnp.sum(cold).astype(jnp.int32)
+                iota = jnp.arange(n, dtype=jnp.int32)
+                crank = jnp.cumsum(cold).astype(jnp.int32) - 1
+                okey = jnp.where(cold & (crank < budget), crank,
+                                 jnp.iinfo(jnp.int32).max)
+                _, cpos = jax.lax.sort((okey, iota), num_keys=1)
+                cpos = cpos[:budget]    # cold positions (garbage past n_cold)
+                c_valid = (jnp.arange(budget, dtype=jnp.int32)
+                           < jnp.minimum(n_cold, budget))
+                rows = jnp.take(host_part, cold_idx[cpos],
+                                axis=0)                     # [budget, dim]
+                tgt = jnp.where(c_valid, cpos, n)           # n = drop slot
+                narrow = hot_rows.at[tgt].set(rows, mode="drop")
+                return jax.lax.cond(n_cold > budget, _full,
+                                    lambda _: narrow, None)
+
+            if dedup:
+                # DEDUPLICATED narrow path: unique over the WHOLE
+                # translated frontier (hot AND cold) — hub repeats
+                # collapse, the host tier is read once per UNIQUE cold
+                # row ([budget, dim], the only host read), both tiers
+                # merge at budget size, and the batch pays exactly ONE
+                # batch-sized op (the inverse expand) where the naive
+                # path pays three (hot gather, cold gather, merge).
+                # Overflow tests the unique count, so a duplicate-heavy
+                # batch whose raw slot count dwarfs the budget still
+                # runs narrow; overflowing batches fall back to the
+                # cold-compaction path, which keeps its own traffic
+                # bound — exact in every case.
+                from .ops.dedup import unique_within_budget
+                valid_pos = (ids_raw >= 0) if masked else None
+                uniq, inv, n_uniq = unique_within_budget(
+                    t, budget, valid=valid_pos)
+                safe_u = jnp.clip(uniq, 0, total - 1)
+                hot_u = safe_u < cache_rows
+                hot_rows_u = gather_cached(dev_part,
+                                           jnp.where(hot_u, safe_u, 0))
+                cold_u = jnp.clip(safe_u - cache_rows, 0,
+                                  max(cold_total - 1, 0))
+                cold_rows_u = jnp.take(host_part, cold_u, axis=0)
+                rows_u = jnp.where(hot_u[:, None], hot_rows_u,
+                                   cold_rows_u)
+                if masked:
+                    # padding expands from a dedicated zero row — the
+                    # narrow path then needs no batch-sized mask
+                    # multiply (the fallback masks inside finish)
+                    zrow = jnp.zeros((1,) + rows_u.shape[1:],
+                                     rows_u.dtype)
+                    rows_u = jnp.concatenate([rows_u, zrow])
+                    inv = jnp.where(valid_pos, inv, budget)
+                narrow_fn = lambda _: jnp.take(rows_u, inv, axis=0)
+                if masked:
+                    return jax.lax.cond(
+                        n_uniq > budget,
+                        lambda _: finish(compacted_lookup()),
+                        narrow_fn, None)
+                return finish(jax.lax.cond(
+                    n_uniq > budget, lambda _: compacted_lookup(),
+                    narrow_fn, None))
+
+            return finish(compacted_lookup())
 
         self._lookup_tiered_raw = lookup_tiered
         self._lookup_tiered = jax.jit(lookup_tiered,
@@ -431,20 +524,30 @@ class Feature:
         return rows * (ids >= 0).astype(rows.dtype)[:, None]
 
     def prefetch(self, node_idx):
-        """Start this lookup on a background thread and return a
+        """Start this lookup on the staging pipeline and return a
         ``concurrent.futures.Future`` whose ``result()`` equals
         ``feature[node_idx]``. The expensive part of a tiered lookup is
-        host-side (cold-row fancy-index + transfer); running it off the
+        host-side (cold-row fancy-index + transfer); staging it off the
         main thread lets batch i+1's staging overlap batch i's model
         step — double-buffering, the TPU answer to the reference's UVA
         gather overlapping transfer with compute
-        (quiver_feature.cu:174-293)."""
+        (quiver_feature.cu:174-293). The pipeline is depth-bounded
+        (backpressure past 2 in-flight batches), ordered, and shut down
+        by :meth:`close` (or automatically when the store is GC'd)."""
         if self._pool is None:
-            import concurrent.futures
-            self._pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=2)
+            from .pipeline import Pipeline
+            self._pool = Pipeline(depth=2, name="quiver-feature-prefetch")
         ids = jnp.asarray(node_idx)    # snapshot before caller moves on
         return self._pool.submit(self.__getitem__, ids)
+
+    def close(self):
+        """Shut down the prefetch pipeline (idempotent). Without an
+        explicit call the pipeline's ``weakref.finalize`` stops the
+        worker when the store is collected — long runs that churn
+        Feature objects no longer accumulate staging threads."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.close()
 
     def _read_cold(self, cold_ids: np.ndarray) -> np.ndarray:
         if self.mmap_array is not None and self.disk_map is not None:
@@ -527,8 +630,9 @@ class Feature:
         self._lookup_tiered_raw = None
         self._host_offload = None
         self._pool = None
-        # older pickles predate the knob
+        # older pickles predate the knobs
         self.__dict__.setdefault("cold_budget", None)
+        self.__dict__.setdefault("dedup_cold", False)
         self._maybe_offload_host()
         self._build_gather()
 
@@ -620,10 +724,18 @@ class DistFeature:
     """
 
     def __init__(self, feature: Optional[Feature], info: PartitionInfo,
-                 comm):
+                 comm, dedup_cold=False):
         self.feature = feature
         self.info = info
         self.comm = comm
+        # dedup_cold: run the SPMD lookup over the batch's UNIQUE ids
+        # (static budget, rounded up to a host multiple) and expand, so
+        # the all_to_all exchange ships each remote row once per batch
+        # instead of once per frontier slot. True = default budget
+        # max(len(ids)//4, hosts); an int sets the budget. Batches
+        # whose unique count overflows fall back to the plain
+        # full-batch lookup (one scalar D2H sync decides the path).
+        self.dedup_cold = dedup_cold
         self._spmd_feat = None         # [H*rows_per_host, dim], P(axis)
         self._rows_per_host = None
         self._lookup_fns = {}
@@ -631,7 +743,7 @@ class DistFeature:
 
     @classmethod
     def from_partition(cls, feat, info: PartitionInfo, comm,
-                       dtype=None) -> "DistFeature":
+                       dtype=None, dedup_cold=False) -> "DistFeature":
         """Build the SPMD store from the FULL feature array + partition
         metadata: each host's rows land in its shard (replicated nodes
         also in every host's tail), row-sharded over ``comm.mesh``."""
@@ -656,7 +768,7 @@ class DistFeature:
                 store[h, base:base + rep_rows] = feat[rep]
         axis = comm.axis
         sharding = NamedSharding(comm.mesh, P(axis))
-        self = cls(None, info, comm)
+        self = cls(None, info, comm, dedup_cold=dedup_cold)
         self._spmd_feat = jax.device_put(
             store.reshape(hosts * rows_per_host, dim), sharding)
         self._rows_per_host = rows_per_host
@@ -678,6 +790,52 @@ class DistFeature:
             raise ValueError(
                 f"SPMD lookup ids length {ids.shape[0]} must be a "
                 f"multiple of the host count {hosts} (pad with -1)")
+        if self.dedup_cold:
+            out = self._getitem_spmd_dedup(ids, hosts)
+            if out is not None:
+                return out              # None: overflow/tiny — fall through
+        return self._getitem_spmd_plain(ids)
+
+    def _getitem_spmd_dedup(self, ids, hosts: int):
+        """Exchange each UNIQUE id once: compact the batch into a
+        static-budget unique table, run the plain SPMD lookup on it,
+        and expand back to batch positions. Fill slots past the unique
+        count hold int32-max (clamped to the last node inside the
+        lookup, so they exchange one real-but-unused row each — never
+        referenced by ``inv``); the batch's own -1 padding dedups to
+        one table entry that the lookup maps to zero rows as usual.
+        Returns None when the budget can't help (budget >= n) or
+        overflows (unique count > budget — exactness preserved by the
+        plain full-batch path); the overflow test costs one scalar D2H
+        sync."""
+        n = ids.shape[0]
+        budget = (int(self.dedup_cold)
+                  if not isinstance(self.dedup_cold, bool)
+                  else max(n // 4, hosts))
+        budget = min(-(-budget // hosts) * hosts, n)   # host multiple
+        if budget >= n:
+            return None
+        key = ("dedup", n, budget)
+        fns = self._lookup_fns.get(key)
+        if fns is None:
+            from .ops.dedup import unique_within_budget
+            import functools
+            compact = jax.jit(functools.partial(
+                unique_within_budget, budget=budget))
+            expand = jax.jit(
+                lambda rows_u, inv: jnp.take(rows_u, inv, axis=0),
+                out_shardings=NamedSharding(self.comm.mesh,
+                                            P(self.comm.axis)))
+            fns = (compact, expand)
+            self._lookup_fns[key] = fns
+        compact, expand = fns
+        uniq, inv, n_uniq = compact(ids)
+        if int(n_uniq) > budget:
+            return None
+        return expand(self._getitem_spmd_plain(uniq), inv)
+
+    def _getitem_spmd_plain(self, ids):
+        hosts = self.info.hosts
         b = ids.shape[0] // hosts
         dim = self._spmd_feat.shape[1]
         key = (b, dim, self._spmd_feat.dtype, self._rep_args is not None)

@@ -33,7 +33,10 @@ class HeteroFeature:
     from ``configs[node_type]`` overlaid on ``default`` (both plain
     kwarg dicts for :class:`Feature` — ``device_cache_size``,
     ``cache_policy``, ``csr_topo``, ``mesh``, ``dtype``,
-    ``host_placement``, ``cold_budget``...).
+    ``host_placement``, ``cold_budget``, ``dedup_cold``...). Hetero
+    frontiers repeat hub nodes across relations, so
+    ``default={"dedup_cold": True}`` bounds every type's host-tier
+    traffic by its unique cold nodes.
     """
 
     def __init__(self, stores: Dict[str, Feature]):
@@ -78,17 +81,26 @@ class HeteroFeature:
                 for t, ids in frontier.items() if ids is not None}
 
     def prefetch(self, frontier: Dict[str, object]):
-        """Start ``lookup(frontier)`` on a background thread; returns a
-        ``Future`` whose ``result()`` equals the lookup. Same
+        """Start ``lookup(frontier)`` on the staging pipeline; returns
+        a ``Future`` whose ``result()`` equals the lookup. Same
         double-buffering story as ``Feature.prefetch``: the host-tier
-        staging of batch i+1 overlaps batch i's model step."""
+        staging of batch i+1 overlaps batch i's model step. Bounded,
+        ordered, shut down by :meth:`close` (or at GC)."""
         if self._pool is None:
-            import concurrent.futures
-            self._pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=2)
+            from .pipeline import Pipeline
+            self._pool = Pipeline(depth=2, name="quiver-hetero-prefetch")
         snap = {t: (None if ids is None else jnp.asarray(ids))
                 for t, ids in frontier.items()}
         return self._pool.submit(self.lookup, snap)
+
+    def close(self):
+        """Shut down the prefetch pipeline and every per-type store's
+        (idempotent)."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.close()
+        for store in self.stores.values():
+            store.close()
 
     def size(self, node_type: str, dim: int) -> int:
         return self.stores[node_type].size(dim)

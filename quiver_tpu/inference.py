@@ -70,7 +70,10 @@ def layerwise_inference(apply_layer: Callable, indptr, indices,
     indptr = jnp.asarray(indptr)
     indices = jnp.asarray(indices)
 
-    @jax.jit
+    # acc is donated: the window loop re-feeds it every iteration, so
+    # XLA accumulates in place instead of allocating a fresh
+    # [batch, dim] buffer per window (hub batches run many windows)
+    @functools.partial(jax.jit, donate_argnums=(3,))
     def window_sum(x_all, nodes, w, acc):
         nbrs, _ = neighborhood_block(indptr, indices, nodes, max_degree, w)
         xn = x_all[jnp.clip(nbrs, 0, n - 1)]

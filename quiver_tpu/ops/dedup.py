@@ -1,0 +1,99 @@
+"""Static-budget frontier deduplication.
+
+Multi-hop frontiers repeat hub nodes many times (a 3-hop products
+frontier revisits high-degree nodes at every hop), so a gather that
+reads one row per frontier *slot* moves duplicate-factor-times more
+bytes than one that reads one row per unique *node*. These helpers make
+that dedup jittable with static shapes: ``unique_within_budget`` ranks
+the distinct values of an id array into a fixed-size table (the
+hub-budget/compaction pattern of ``sample_layer_exact_wide``) plus an
+inverse map back to the original positions. Consumers gather each
+unique row once and expand — with a ``lax.cond`` full-gather fallback
+when the unique count overflows the budget, so exactness never depends
+on the budget (FastSample's dedup/compaction lever, arxiv 2311.17847,
+expressed in fixed-shape XLA).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def unique_within_budget(ids: jax.Array, budget: int, valid=None):
+    """Compact the distinct values of ``ids`` into a static-size table.
+
+    Returns ``(uniq, inv, n_uniq)``:
+
+      uniq   [budget] int32 — the first ``min(n_uniq, budget)`` distinct
+             values in ascending order, int32-max fill past ``n_uniq``
+             (keeps the table sorted; consumers clip before gathering)
+      inv    [n] int32 in [0, budget) — ``uniq[inv[i]] == ids[i]`` for
+             every counted position ``i`` whenever ``n_uniq <= budget``
+             (garbage, but in-range, at uncounted positions and on
+             overflow — callers must gate on ``n_uniq`` / ``valid``)
+      n_uniq []  int32 — the true distinct count (may exceed budget;
+             callers branch to a full gather via ``lax.cond`` then)
+
+    ``valid`` (optional [n] bool) excludes positions from the count —
+    excluded slots neither consume budget nor get a meaningful ``inv``.
+    Positions are excluded by keying them to int32 max, so ids must stay
+    below it (node/row ids always do).
+
+    Cost note: sorting the VALUES alone and recovering ``inv`` with a
+    ``searchsorted`` over the (sorted) unique table measures ~2.3x
+    faster on the CPU backend than the (key, position)-pair sort +
+    inverse scatter it replaces — the sort is the dedup path's largest
+    non-gather cost, so this is what keeps dedup profitable even where
+    all memory tiers run at one speed. No data-dependent shapes.
+    """
+    ids = ids.astype(jnp.int32)
+    n = ids.shape[0]
+    key = ids if valid is None else jnp.where(valid, ids, _I32_MAX)
+    skey = jax.lax.sort(key, is_stable=False)
+    first = jnp.concatenate([jnp.ones((1,), bool), skey[1:] != skey[:-1]])
+    new = (first & (skey != _I32_MAX)) if valid is not None else first
+    n_uniq = jnp.sum(new).astype(jnp.int32)
+    urank = jnp.cumsum(new).astype(jnp.int32) - 1
+    tgt = jnp.where(new & (urank < budget), urank, budget)  # budget = drop
+    uniq = jnp.full((budget,), _I32_MAX, jnp.int32).at[tgt].set(
+        skey, mode="drop")
+    inv = jnp.clip(jnp.searchsorted(uniq, key), 0,
+                   budget - 1).astype(jnp.int32)
+    return uniq, inv, n_uniq
+
+
+def dedup_take(table: jax.Array, ids: jax.Array, budget: int,
+               valid=None) -> jax.Array:
+    """``jnp.take(table, ids, axis=0)`` reading each distinct id ONCE.
+
+    The only ``table``-sized read on the narrow path is a
+    [budget, dim] gather of the unique rows; positions then expand from
+    that small array. When the distinct count overflows ``budget`` a
+    ``lax.cond`` falls back to the full positional gather — identical
+    results in every case, only the traffic bound degrades. Rows at
+    excluded (``valid=False``) positions and at the int32-max fill are
+    whatever the clipped reads produce — callers mask them.
+
+    Pays off when ``table`` lives in a slow tier (pinned host memory)
+    and ``ids`` carries duplicates (frontier duplicate factor > ~1.3);
+    a duplicate-free batch degenerates to the same bytes as the plain
+    gather plus one sort.
+    """
+    n = ids.shape[0]
+    rows = table.shape[0]
+    if budget >= n:
+        return jnp.take(table, jnp.clip(ids, 0, max(rows - 1, 0)), axis=0)
+    uniq, inv, n_uniq = unique_within_budget(ids, budget, valid=valid)
+
+    def narrow(_):
+        uniq_rows = jnp.take(table, jnp.clip(uniq, 0, max(rows - 1, 0)),
+                             axis=0)                    # [budget, dim]
+        return jnp.take(uniq_rows, inv, axis=0)
+
+    def full(_):
+        return jnp.take(table, jnp.clip(ids, 0, max(rows - 1, 0)), axis=0)
+
+    return jax.lax.cond(n_uniq > budget, full, narrow, None)

@@ -31,8 +31,9 @@ from .._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..comm import dist_lookup_local
-from .train import (TrainState, _check_rows, _fused_loss,
-                    _pmean_update, cross_entropy_logits)
+from .train import (TrainState, _check_donatable, _check_rows,
+                    _fused_loss, _pmean_update, cross_entropy_logits,
+                    _DONATED_DOC)
 
 
 def build_dist_train_step(model, tx, sizes: Sequence[int],
@@ -43,7 +44,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                           method: str = "exact",
                           indices_stride: int | None = None,
                           with_replicate: bool = False,
-                          hub_frac: float | None = None):
+                          hub_frac: float | None = None,
+                          donate: bool = True):
     """fn(state, spmd_feat, g2h, g2l, indptr, indices, seeds, labels,
     key[, indices_rows][, is_rep, rep_rank, bases]) -> (state, loss).
 
@@ -101,9 +103,10 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
             make_per_shard(has_rows), mesh=mesh,
             in_specs=tuple(specs),
             out_specs=(P(), P()),
-            check_vma=False))
+            check_vma=False), donate_argnums=(0,) if donate else ())
 
     jitted_by_rows = {True: make_jitted(True), False: make_jitted(False)}
+    checked = set()
 
     def step(state, feat, g2h, g2l, indptr, indices, seeds, labels, key,
              indices_rows=None, rep_args=()):
@@ -119,7 +122,15 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
             extra += tuple(rep_args)
         elif rep_args:
             raise TypeError("rep_args given but with_replicate=False")
+        if donate:
+            _check_donatable("build_dist_train_step", jitted, checked,
+                             state, feat, g2h, g2l, indptr, indices,
+                             seeds, labels, key, *extra)
         return jitted(state, feat, g2h, g2l, indptr, indices, seeds,
                       labels, key, *extra)
 
     return step
+
+
+if build_dist_train_step.__doc__:        # None under python -OO
+    build_dist_train_step.__doc__ += _DONATED_DOC

@@ -64,6 +64,40 @@ def masked_feature_gather(feat: jax.Array, n_id: jax.Array,
     return x * (n_id >= 0).astype(x.dtype)[:, None]
 
 
+def dedup_feature_gather(feat: jax.Array, n_id: jax.Array,
+                         feature_order=None,
+                         budget: int | None = None) -> jax.Array:
+    """``masked_feature_gather`` reading each distinct valid id ONCE:
+    the frontier's -1 padding (the bulk of a static multi-hop cap) and
+    any repeated ids collapse into a static-``budget`` unique table,
+    the feature read is one [budget, dim] gather, and positions expand
+    from it. Falls back to the plain full gather via ``lax.cond`` when
+    the unique count overflows — identical output in every case.
+    Default budget: ``max(len(n_id)//4, 256)``."""
+    from ..ops.dedup import unique_within_budget
+    n = n_id.shape[0]
+    if budget is None:
+        budget = max(n // 4, 256)
+    if budget >= n:
+        return masked_feature_gather(feat, n_id, feature_order)
+    valid = n_id >= 0
+    uniq, inv, n_uniq = unique_within_budget(n_id, budget, valid=valid)
+
+    def narrow(_):
+        # uniq's int32-max fill clips to the LAST feature row — those
+        # slots hold real (unused) data, NOT zeros: inv never points a
+        # valid position at them, and invalid positions carry in-range-
+        # garbage inv that the re-mask below zeroes
+        rows_u = masked_feature_gather(feat, uniq, feature_order)
+        x = jnp.take(rows_u, inv, axis=0)
+        return x * valid.astype(x.dtype)[:, None]
+
+    return jax.lax.cond(n_uniq > budget,
+                        lambda _: masked_feature_gather(feat, n_id,
+                                                        feature_order),
+                        narrow, None)
+
+
 def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
                 indptr, indices, seeds, labels, key, method="exact",
                 indices_rows=None, indices_stride=None, gather=None,
@@ -117,11 +151,74 @@ def _pmean_update(state, tx, grads, loss, axis):
     return TrainState(params, opt_state, state.step + 1), loss
 
 
+def _check_donatable(kind, fn, checked, state, *args, **kwargs):
+    """Pre-flight guard for donated ``TrainState`` args: XLA quietly
+    falls back to a COPY when a donated buffer can't be reused because
+    the returned state's shape/dtype/structure drifted (e.g. an optax
+    chain that changes a moment's dtype) — the donation "works" but
+    every step still reallocates. Trace the step abstractly on the
+    FIRST call per jitted fn and fail loudly on any drift. Single-shot
+    by design: once the in/out specs match, every later state IS a
+    prior output (same specs by induction), so the steady-state cost
+    is one O(1) set lookup, not a per-step pytree walk."""
+    if id(fn) in checked:
+        return
+    out_state = jax.eval_shape(fn, state, *args, **kwargs)[0]
+    flat_in, tree_in = jax.tree_util.tree_flatten_with_path(state)
+    flat_out, tree_out = jax.tree_util.tree_flatten_with_path(out_state)
+    if tree_in != tree_out:
+        raise ValueError(
+            f"{kind}: donated TrainState changes pytree structure "
+            f"across the step ({tree_in} -> {tree_out}); donation would "
+            "silently copy every buffer. Fix the model/optimizer to "
+            "return the same structure, or pass donate=False.")
+    bad = [
+        (jax.tree_util.keystr(p_in),
+         (tuple(jnp.shape(a)), str(jnp.result_type(a))),
+         (tuple(b.shape), str(b.dtype)))
+        for (p_in, a), (_, b) in zip(flat_in, flat_out)
+        if tuple(jnp.shape(a)) != tuple(b.shape)
+        or jnp.result_type(a) != b.dtype]
+    if bad:
+        detail = "; ".join(f"{p}: {i} -> {o}" for p, i, o in bad[:4])
+        raise ValueError(
+            f"{kind}: donated TrainState leaves change shape/dtype "
+            f"across the step ({detail}) — XLA cannot reuse the donated "
+            "buffers and would silently copy them every step. Make the "
+            "step shape/dtype-stable, or pass donate=False.")
+    checked.add(id(fn))
+
+
+_DONATED_DOC = """
+
+    ``donate=True`` (default) donates the ``state`` argument's buffers
+    to the step: the update writes in place instead of reallocating the
+    full model+optimizer state every step. The INPUT state is dead
+    after the call — use the returned state, and pass ``donate=False``
+    when a caller genuinely needs to reuse one state across several
+    step calls (A/B parity comparisons). A shape/dtype guard traces the
+    step abstractly on first use and raises a clear error if the state
+    drifts across the step (which would turn donation into a silent
+    per-step copy)."""
+
+
+def _dedup_gather_fn(dedup_gather):
+    """``dedup_gather`` knob -> the gather callable ``_fused_loss``
+    takes (None keeps the plain masked gather)."""
+    if dedup_gather is None:
+        return None
+    budget = None if dedup_gather is True else int(dedup_gather)
+    return lambda feat, n_id, forder: dedup_feature_gather(
+        feat, n_id, forder, budget)
+
+
 def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                      loss_fn: Callable = cross_entropy_logits,
                      method: str = "exact",
                      indices_stride: int | None = None,
-                     hub_frac: float | None = None):
+                     hub_frac: float | None = None,
+                     donate: bool = True,
+                     dedup_gather=None):
     """Single-chip fused step:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]). With ``method="rotation"`` pass the shuffled
@@ -132,23 +229,36 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
     index memory). With ``method="exact"`` + an un-shuffled layout view
     as ``indices_rows``, pass ``hub_frac`` (the cached
     ``CSRTopo.exact_bucket_meta().frac``) so the wide-exact hub budget
-    is sized from the graph's degree-bucket split."""
+    is sized from the graph's degree-bucket split. ``dedup_gather``
+    (True or an int unique budget) swaps the frontier feature gather
+    for ``dedup_feature_gather`` — one read per distinct node instead
+    of per frontier slot."""
     sizes = list(sizes)
+    gather = _dedup_gather_fn(dedup_gather)
 
-    @jax.jit
     def step(state: TrainState, feat, forder, indptr, indices, seeds,
              labels, key, indices_rows=None):
         loss, grads = jax.value_and_grad(
             lambda p: _fused_loss(model, loss_fn, sizes, batch_size, p, feat,
                                   forder, indptr, indices, seeds, labels, key,
                                   method, indices_rows, indices_stride,
-                                  hub_frac=hub_frac)
+                                  gather=gather, hub_frac=hub_frac)
         )(state.params)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
-    return step
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    if not donate:
+        return jitted
+    checked = set()
+
+    def guarded(state, *args, **kwargs):
+        _check_donatable("build_train_step", jitted, checked, state,
+                         *args, **kwargs)
+        return jitted(state, *args, **kwargs)
+
+    return guarded
 
 
 def build_e2e_train_step(model, tx, sizes: Sequence[int],
@@ -157,7 +267,9 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                          loss_fn: Callable = cross_entropy_logits,
                          method: str = "exact",
                          indices_stride: int | None = None,
-                         hub_frac: float | None = None):
+                         hub_frac: float | None = None,
+                         donate: bool = True,
+                         dedup_gather=None):
     """Data-parallel fused step over ``mesh[axis]``:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]) with seeds/labels [n_dev * per_device_batch] sharded
@@ -166,8 +278,11 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     ``indices_stride=128`` switches ``indices_rows`` to the
     ``as_index_rows_overlapping`` layout (one row gather per seed).
     ``hub_frac`` (cached ``CSRTopo.exact_bucket_meta().frac``) sizes the
-    wide-exact hub budget when exact mode gets an ``indices_rows``."""
+    wide-exact hub budget when exact mode gets an ``indices_rows``.
+    ``dedup_gather`` (True or an int unique budget) swaps each shard's
+    frontier feature gather for ``dedup_feature_gather``."""
     sizes = list(sizes)
+    gather = _dedup_gather_fn(dedup_gather)
 
     def per_shard(state: TrainState, feat, forder, indptr, indices, seeds,
                   labels, key, indices_rows=None):
@@ -176,7 +291,8 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
             lambda p: _fused_loss(model, loss_fn, sizes, per_device_batch, p,
                                   feat, forder, indptr, indices, seeds,
                                   labels, key, method, indices_rows,
-                                  indices_stride, hub_frac=hub_frac)
+                                  indices_stride, gather=gather,
+                                  hub_frac=hub_frac)
         )(state.params)
         return _pmean_update(state, tx, grads, loss, axis)
 
@@ -194,8 +310,10 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
         in_specs=tuple(specs),
         out_specs=(P(), P()),
         check_vma=False)
-    jitted_rows = jax.jit(with_rows)
-    jitted = jax.jit(without_rows)
+    dn = (0,) if donate else ()
+    jitted_rows = jax.jit(with_rows, donate_argnums=dn)
+    jitted = jax.jit(without_rows, donate_argnums=dn)
+    checked = set()
 
     # validate the optional arg up front so a mismatch is a clear
     # TypeError, not an opaque shard_map/jit arity failure
@@ -203,10 +321,16 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
              indices_rows=None):
         _check_rows(method, indices_rows, "e2e")
         if indices_rows is not None:
-            return jitted_rows(state, feat, forder, indptr, indices, seeds,
-                               labels, key, indices_rows)
-        return jitted(state, feat, forder, indptr, indices, seeds, labels,
-                      key)
+            args = (feat, forder, indptr, indices, seeds, labels, key,
+                    indices_rows)
+            fn = jitted_rows
+        else:
+            args = (feat, forder, indptr, indices, seeds, labels, key)
+            fn = jitted
+        if donate:
+            _check_donatable("build_e2e_train_step", fn, checked, state,
+                             *args)
+        return fn(state, *args)
 
     return step
 
@@ -215,7 +339,8 @@ def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                            loss_fn: Callable = cross_entropy_logits,
                            method: str = "exact",
                            indices_stride: int | None = None,
-                           hub_frac: float | None = None):
+                           hub_frac: float | None = None,
+                           donate: bool = True):
     """Two-phase step for tiered feature stores (the reference's own
     architecture: sampling and feature collection run as separate stages
     around the model, examples/pyg/reddit_quiver.py:116-122):
@@ -224,8 +349,12 @@ def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
       step_fn(state, x, adjs, labels, key) -> (state, loss)
 
     Use when features live partly on host/disk: sample on device, fetch
-    ``x = feature[n_id]`` through the tiered store, then run the fused
-    forward/backward/update.
+    ``x = feature[n_id]`` through the tiered store (give the store
+    ``dedup_cold=True`` so the host tier is read once per unique cold
+    node; pair with ``Feature.prefetch`` / ``quiver_tpu.pipeline`` so
+    batch i+1's staging overlaps step i), then run the fused
+    forward/backward/update. ``sample_fn``'s inputs (topology, seeds)
+    are reused across steps, so nothing there is donatable.
     """
     sizes = list(sizes)
 
@@ -240,8 +369,7 @@ def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
             else None, seeds_dense=True, hub_frac=hub_frac)
         return n_id, layers_to_adjs(layers, batch_size, sizes)
 
-    @jax.jit
-    def step_fn(state: TrainState, x, adjs, labels, key):
+    def step_fn_raw(state: TrainState, x, adjs, labels, key):
         def loss_of(p):
             logits = model.apply(p, x, adjs, train=True,
                                  rngs={"dropout": key})
@@ -252,9 +380,28 @@ def build_split_train_step(model, tx, sizes: Sequence[int], batch_size: int,
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
+    jitted = jax.jit(step_fn_raw, donate_argnums=(0,) if donate else ())
+    if not donate:
+        return sample_fn, jitted
+    checked = set()
+
+    def step_fn(state, *args, **kwargs):
+        _check_donatable("build_split_train_step", jitted, checked, state,
+                         *args, **kwargs)
+        return jitted(state, *args, **kwargs)
+
     return sample_fn, step_fn
 
 
 def init_state(model, tx, example_x, example_adjs, key) -> TrainState:
     params = model.init(key, example_x, example_adjs)
     return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+
+
+# the donation contract is identical across the step builders — stamp
+# it onto each docstring once instead of drifting three copies
+# (guarded: under python -OO docstrings are None)
+for _b in (build_train_step, build_e2e_train_step, build_split_train_step):
+    if _b.__doc__:
+        _b.__doc__ += _DONATED_DOC
+del _b

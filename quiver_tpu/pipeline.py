@@ -1,0 +1,190 @@
+"""Bounded double-buffered host-staging pipeline.
+
+The training loop's only host-side work per batch is staging the cold
+tier's feature rows (the fancy-index + H2D transfer inside
+``Feature.__getitem__``); everything else is device dispatches. This
+module gives that staging a real executor instead of the ad-hoc
+two-worker thread pools the stores used to spawn and never shut down:
+
+- **one** worker thread per pipeline, so results complete in submission
+  order deterministically (no pool-scheduling races);
+- a **bounded** queue (``depth``, default 2 = classic double-buffer):
+  ``submit`` applies backpressure instead of queueing an unbounded
+  backlog of staged batches ahead of the device;
+- **clean shutdown**: idempotent ``close()`` (cancels queued work,
+  stops the worker), context-manager support, and a ``weakref.finalize``
+  safety net so a dropped pipeline cannot leak its thread across long
+  runs;
+- an **injectable failure path**: a stage that raises surfaces the
+  exception through ``Future.result()`` (and through ``map``/
+  ``pipelined``, which cancel the remaining in-flight work first) —
+  the pipeline itself stays shut down cleanly, never wedged.
+
+``Feature.prefetch`` / ``HeteroFeature.prefetch`` route through this
+executor; a training loop can also drive it directly::
+
+    from quiver_tpu.pipeline import pipelined
+    for x in pipelined(lambda ids: feature[ids], id_batches):
+        state, loss = step(state, x, ...)   # batch i+1 stages meanwhile
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import weakref
+from concurrent.futures import Future
+from typing import Callable, Iterable, Iterator
+
+_STOP = object()
+
+
+def _worker(q: "queue.Queue"):
+    while True:
+        item = q.get()
+        if item is _STOP:
+            return
+        fut, fn, args, kwargs = item
+        if not fut.set_running_or_notify_cancel():
+            continue                     # cancelled while queued
+        try:
+            fut.set_result(fn(*args, **kwargs))
+        except BaseException as e:       # surfaces via fut.result()
+            fut.set_exception(e)
+
+
+def _drain_cancel(q: "queue.Queue"):
+    while True:
+        try:
+            item = q.get_nowait()
+        except queue.Empty:
+            return
+        if item is not _STOP:
+            item[0].cancel()
+
+
+def _finalize_shutdown(q: "queue.Queue", box: dict):
+    """GC safety net (must not reference the Pipeline itself): cancel
+    queued work and stop the worker so a dropped pipeline leaks no
+    thread. No join — this can run from the GC."""
+    _drain_cancel(q)
+    t = box.get("thread")
+    if t is not None and t.is_alive():
+        q.put(_STOP)
+
+
+class Pipeline:
+    """Single-worker, depth-bounded staging executor (see module doc).
+
+    ``submit(fn, *args, **kwargs)`` returns a ``concurrent.futures.
+    Future`` and blocks once ``depth`` items are queued (backpressure).
+    ``map(fn, items)`` yields ``fn(item)`` results in order with at
+    most ``depth`` stages in flight.
+    """
+
+    def __init__(self, depth: int = 2, name: str = "quiver-pipeline"):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._depth = depth
+        self._name = name
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._box: dict = {"thread": None}
+        self._closed = False
+        self._lock = threading.Lock()
+        self._finalizer = weakref.finalize(self, _finalize_shutdown,
+                                           self._q, self._box)
+
+    # -- core ---------------------------------------------------------------
+    def submit(self, fn: Callable, *args, **kwargs) -> Future:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(f"{self._name}: pipeline is closed")
+            if self._box["thread"] is None:
+                t = threading.Thread(target=_worker, args=(self._q,),
+                                     name=self._name, daemon=True)
+                t.start()
+                self._box["thread"] = t
+        fut: Future = Future()
+        self._q.put((fut, fn, args, kwargs))     # blocks at depth
+        if self._closed:
+            # close() raced our enqueue (its drain may have run before
+            # our put landed, stranding the item behind _STOP with no
+            # worker): reclaim it so the Future can never hang. If the
+            # worker already picked it up, cancel() fails and the item
+            # completes normally.
+            if fut.cancel():
+                raise RuntimeError(f"{self._name}: pipeline is closed")
+        return fut
+
+    def map(self, fn: Callable, items: Iterable) -> Iterator:
+        """Yield ``fn(item)`` for each item, in order, keeping up to
+        ``depth`` stages in flight. An exception from any stage
+        propagates at its yield point after cancelling the not-yet-
+        running remainder (the running stage finishes; its result is
+        dropped)."""
+        pending: collections.deque = collections.deque()
+        it = iter(items)
+        exhausted = False
+        try:
+            while pending or not exhausted:
+                while not exhausted and len(pending) < self._depth:
+                    try:
+                        x = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(self.submit(fn, x))
+                if pending:
+                    yield pending.popleft().result()
+        finally:
+            while pending:
+                pending.popleft().cancel()
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self, wait: bool = True):
+        """Cancel queued work and stop the worker. Idempotent; safe to
+        call from any thread; also runs (joinless) via the GC
+        finalizer."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            t = self._box["thread"]
+            self._box["thread"] = None
+        self._finalizer.detach()
+        _drain_cancel(self._q)
+        if t is not None:
+            self._q.put(_STOP)
+            # a stage fn / Future done-callback may close the pipeline
+            # from the worker itself — joining the current thread would
+            # raise, so skip the join there (the worker exits on _STOP)
+            if wait and t is not threading.current_thread():
+                t.join()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "Pipeline":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __repr__(self):
+        state = "closed" if self._closed else "open"
+        return f"Pipeline({self._name!r}, depth={self._depth}, {state})"
+
+
+def pipelined(fn: Callable, items: Iterable, depth: int = 2,
+              name: str = "quiver-pipelined") -> Iterator:
+    """Run ``fn`` over ``items`` on a fresh background pipeline,
+    yielding results in order with up to ``depth`` stages in flight.
+    The pipeline is closed when the generator finishes — normally, on a
+    stage exception, or when the consumer abandons it."""
+    p = Pipeline(depth=depth, name=name)
+    try:
+        yield from p.map(fn, items)
+    finally:
+        p.close()

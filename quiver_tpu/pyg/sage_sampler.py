@@ -621,8 +621,24 @@ class MixedGraphSageSampler:
     def _ensure_pool(self):
         if self._pool is None:
             import concurrent.futures
+            import weakref
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=self.num_workers)
+            # lifecycle: host-sampling threads must not outlive the
+            # sampler across long runs — explicit close() below, with a
+            # GC finalizer safety net (bound to the pool, not self)
+            self._pool_finalizer = weakref.finalize(
+                self, self._pool.shutdown, wait=False)
+
+    def close(self):
+        """Shut down the host-sampling worker pool (idempotent); safe
+        to call between epochs — the next iteration re-creates it."""
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            fin = getattr(self, "_pool_finalizer", None)
+            if fin is not None:
+                fin.detach()
+            pool.shutdown(wait=True, cancel_futures=True)
 
     def _ema(self, old, dt):
         a = self.EMA_ALPHA

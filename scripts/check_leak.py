@@ -6,6 +6,13 @@ prefetch cycles and assert that (a) the number of live jax arrays and
 (b) host RSS stay bounded — i.e. per-batch work leaks neither device
 buffers nor host memory. Runs on the CPU backend so CI can gate on it.
 
+Phase 2 drives the PIPELINED loop 50 batches through a dedup_cold
+store plus a donated train step, and additionally pins the EXECUTABLE
+caches: the dedup bucketing and the donation path both rely on static
+shapes — a shape regression there shows up as per-batch recompiles
+(unbounded executable-cache growth), which live-array counts alone
+would miss.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -69,7 +76,81 @@ def main():
     # linearly with cycles (60 cycles x ~10 arrays each would be +600)
     assert arrays <= base_arrays + 16, "device buffer leak"
     assert rss <= base_rss + 256, "host memory leak"
-    print("no leak detected")
+    store.close()
+    print("no leak detected (phase 1: prefetch cycles)")
+
+    # ---- phase 2: pipelined dedup lookups + donated train steps ----
+    import optax
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.parallel import build_train_step
+    from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                           masked_feature_gather)
+    from quiver_tpu.pipeline import pipelined
+
+    dstore = qv.Feature(device_cache_size=n // 4 * dim * 4, csr_topo=topo,
+                        dedup_cold=True, cold_budget=256)
+    dstore.from_cpu_tensor(feat)
+    host = jnp.asarray(dstore.host_part)
+
+    def dedup_lookup(ids):
+        out = dstore._lookup_tiered(dstore.device_part, host, ids,
+                                    dstore.feature_order)
+        jax.block_until_ready(out)
+        return out
+
+    def dup_batches(count, size=2048):
+        for i in range(count):
+            pool = rng.integers(0, n, size // 4)
+            yield jnp.asarray(pool[rng.integers(0, pool.size, size)]
+                              .astype(np.int32))
+
+    sizes, bs = [10, 5], 512
+    model = GraphSAGE(hidden_dim=32, out_dim=8, num_layers=2, dropout=0.0)
+    tx = optax.adam(1e-3)
+    indptr_j = jnp.asarray(indptr.astype(np.int32))
+    indices_j = jnp.asarray(indices.astype(np.int32))
+    feat_j = jnp.asarray(feat)
+    labels = jnp.asarray(rng.integers(0, 8, n).astype(np.int32))
+    n_id, layers = sample_multihop(indptr_j, indices_j,
+                                   jnp.arange(bs, dtype=jnp.int32),
+                                   sizes, jax.random.key(0))
+    state = init_state(model, tx, masked_feature_gather(feat_j, n_id),
+                       layers_to_adjs(layers, bs, sizes),
+                       jax.random.key(1))
+    step = build_train_step(model, tx, sizes, bs)   # donated state
+
+    def one_step(state, it):
+        seeds = jnp.asarray(rng.integers(0, n, bs, dtype=np.int32))
+        return step(state, feat_j, None, indptr_j, indices_j, seeds,
+                    labels[seeds], jax.random.key(it))
+
+    # warmup: compile the lookup + the step, settle caches
+    for _ in pipelined(dedup_lookup, dup_batches(3)):
+        pass
+    state, _ = one_step(state, 0)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    cache_sizes = {
+        "lookup_tiered": dstore._lookup_tiered._cache_size(),
+    }
+
+    for i, out in enumerate(pipelined(dedup_lookup, dup_batches(50))):
+        state, loss = one_step(state, 100 + i)
+    jax.block_until_ready(loss)
+    del out
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = dstore._lookup_tiered._cache_size() - cache_sizes[
+        "lookup_tiered"]
+    print(f"phase 2 live arrays: {base_arrays} -> {arrays}; "
+          f"lookup executable-cache growth: {grew}")
+    # static shapes => ZERO new executables over 50 same-shape batches
+    assert grew == 0, "dedup lookup recompiled mid-loop (shape leak)"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak in the pipelined/donated loop"
+    dstore.close()
+    print("no leak detected (phase 2: pipelined dedup + donated steps)")
 
 
 if __name__ == "__main__":

@@ -41,5 +41,7 @@ def test_bench_cpu_smoke_json_contract():
     for mode in ("rotation", "exact", "window"):
         assert out[f"{mode}_mode_value"] > 0
         assert out[f"{mode}_mode_vs_baseline"] is None   # not comparable
+    # the bandwidth half: dedup tiered feature-gather rows/sec
+    assert out["feature_gather_rows_per_s"] > 0
     assert out["vs_baseline"] is None
     assert "error" not in out

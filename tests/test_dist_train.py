@@ -70,8 +70,9 @@ class TestDistTrainStep:
         seeds_s = jax.device_put(seeds, sharding)
         y_s = jax.device_put(y, sharding)
 
+        # donate=False: the dist arm replays the SAME state right after
         dp_step = build_e2e_train_step(model, tx, sizes, per_host, mesh,
-                                       axis="host")
+                                       axis="host", donate=False)
         dp_state, dp_loss = dp_step(state, feat, None, indptr, indices,
                                     seeds_s, y_s, key)
 
@@ -108,7 +109,8 @@ class TestDistTrainStep:
         y_s = jax.device_put(y, sharding)
 
         dp_step = build_e2e_train_step(model, tx, sizes, per_host, mesh,
-                                       axis="host", method="rotation")
+                                       axis="host", method="rotation",
+                                       donate=False)
         _, dp_loss = dp_step(state, feat, None, indptr, indices, seeds_s,
                              y_s, key, rows)
         dist_step = build_dist_train_step(
@@ -168,7 +170,7 @@ class TestDistTrainStep:
         key = jax.random.key(33)
 
         dp_step = build_e2e_train_step(model, tx, sizes, per_host, mesh,
-                                       axis="host")
+                                       axis="host", donate=False)
         _, dp_loss = dp_step(state, jnp.asarray(feat), None, indptr_j,
                              indices_j, seeds_s, y_s, key)
         dist_step = build_dist_train_step(

@@ -157,6 +157,28 @@ class TestFeature:
         np.testing.assert_allclose(
             np.asarray(f[jnp.asarray(ids)]), feat[ids], rtol=1e-6)
 
+    def test_second_store_sharing_reindexed_topo(self, rng):
+        """A csr_topo already carrying a feature_order (set by an
+        earlier store's reindex) must still yield correct lookups from
+        a second store built on the RAW tensor — the stored permutation
+        has to be applied to the new tensor, not just assumed."""
+        n, dim = 80, 4
+        deg = rng.integers(1, 12, n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]))
+        topo = qv.CSRTopo(indptr=indptr, indices=indices)
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        first = qv.Feature(device_cache_size=20 * dim * 4, csr_topo=topo)
+        first.from_cpu_tensor(feat)
+        assert topo.feature_order is not None
+        second = qv.Feature(device_cache_size=30 * dim * 4,
+                            csr_topo=topo)
+        second.from_cpu_tensor(feat)
+        ids = rng.integers(0, n, 40)
+        np.testing.assert_allclose(
+            np.asarray(second[jnp.asarray(ids)]), feat[ids], rtol=1e-6)
+
     def test_sharded_policy_on_mesh(self):
         mesh = Mesh(np.array(jax.devices()), axis_names=("cache",))
         f, feat = make_feature(n=128, cache_frac=1.0,
@@ -395,6 +417,34 @@ class TestDistFeatureSPMD:
         np.testing.assert_allclose(out[valid], full[ids[valid]],
                                    rtol=1e-6)
         assert (out[~valid] == 0).all()
+
+    def test_dedup_matches_plain_lookup(self, rng):
+        """dedup_cold on the SPMD path: unique-compacted exchange must
+        equal the plain full-batch lookup on duplicate-heavy batches
+        (with -1 padding mixed in) and fall back exactly on overflow."""
+        n, dim, hosts = 64, 8, 8
+        full = rng.standard_normal((n, dim)).astype(np.float32)
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        for dedup in (True, 16):        # default + explicit budget
+            dist = qv.DistFeature.from_partition(full, info, comm,
+                                                 dedup_cold=dedup)
+            pool = rng.integers(0, n, size=12)
+            ids = pool[rng.integers(0, 12, 8 * 16)].astype(np.int32)
+            ids[::9] = -1
+            out = np.asarray(dist[jnp.asarray(ids)])
+            valid = ids >= 0
+            np.testing.assert_allclose(out[valid], full[ids[valid]],
+                                       rtol=1e-6)
+            assert (out[~valid] == 0).all()
+            # unique count >> budget: overflow falls back, still exact
+            wide = rng.integers(0, n, size=8 * 16).astype(np.int32)
+            out = np.asarray(dist[jnp.asarray(wide)])
+            np.testing.assert_allclose(out, full[wide], rtol=1e-6)
 
     def test_bf16_dtype(self, rng):
         full = rng.standard_normal((64, 8)).astype(np.float32)
@@ -635,6 +685,213 @@ class TestOffloadHostTier:
         want = np.zeros((64, dim), np.float32)
         want[:3] = feat[[5, 0, 119]]
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_dedup_lookup_matches_naive_tiered(self):
+        """dedup_cold gathers each unique cold row once; output must be
+        byte-identical to the naive tiered path on duplicate-heavy
+        frontiers, across the budget boundary (unique counts 0..over)."""
+        rng = np.random.default_rng(13)
+        n, dim, budget = 200, 8, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=100 * dim * 4,
+                       cold_budget=budget, dedup_cold=True)
+        f.from_cpu_tensor(feat)
+        host = jnp.asarray(f.host_part)
+        for uniq_cold in (0, 3, budget, budget + 1, 30):
+            pool = rng.choice(np.arange(100, n), size=max(uniq_cold, 1),
+                              replace=False)
+            cold = (pool[rng.integers(0, pool.size, 24)]
+                    if uniq_cold else np.empty(0, np.int64))
+            ids = np.concatenate([
+                rng.integers(0, 100, size=32 - cold.size), cold])
+            rng.shuffle(ids)
+            ids = jnp.asarray(ids)
+            want = np.asarray(f[ids])         # numpy host path (naive)
+            got = np.asarray(f._lookup_tiered(
+                f.device_part, host, ids, f.feature_order))
+            np.testing.assert_allclose(got, want, rtol=1e-6,
+                                       err_msg=f"uniq_cold={uniq_cold}")
+
+    def test_dedup_duplicates_exceed_budget_but_uniques_fit(self):
+        """The dedup narrow path's overflow test is on the UNIQUE count:
+        a batch with 60 cold slots over 4 distinct nodes must stay on
+        the narrow (budget-8) path and still be exact."""
+        rng = np.random.default_rng(17)
+        n, dim = 200, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=100 * dim * 4,
+                       cold_budget=8, dedup_cold=True)
+        f.from_cpu_tensor(feat)
+        host = jnp.asarray(f.host_part)
+        pool = np.array([110, 150, 177, 199])
+        ids = np.concatenate([pool[rng.integers(0, 4, 60)],
+                              rng.integers(0, 100, 4)])
+        rng.shuffle(ids)
+        ids = jnp.asarray(ids)
+        np.testing.assert_allclose(
+            np.asarray(f._lookup_tiered(f.device_part, host, ids,
+                                        f.feature_order)),
+            np.asarray(f[ids]), rtol=1e-6)
+
+    def test_dedup_hot_heavy_overflow_falls_back_compacted(self):
+        """A hot-heavy batch can overflow the UNIQUE budget while its
+        cold slots fit the compaction budget: the dedup fallback must
+        be the cold-compaction narrow path (budget-bounded host read),
+        not the full-batch gather — and stay exact."""
+        import jax as _jax
+        rng = np.random.default_rng(41)
+        n, dim, budget = 400, 8, 16
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=300 * dim * 4,
+                       cold_budget=budget, dedup_cold=True)
+        f.from_cpu_tensor(feat)
+        host = jnp.asarray(f.host_part)
+        # 60 distinct hot ids (unique count 64 > budget 16), 4 cold
+        # slots (fits the compaction budget)
+        ids = np.concatenate([
+            rng.choice(300, size=60, replace=False),
+            rng.integers(300, n, size=4)])
+        rng.shuffle(ids)
+        ids = jnp.asarray(ids)
+        np.testing.assert_allclose(
+            np.asarray(f._lookup_tiered(f.device_part, host, ids,
+                                        f.feature_order)),
+            np.asarray(f[ids]), rtol=1e-6)
+        # traffic bound: every batch-sized host gather lives inside a
+        # NESTED cond (the compaction fallback's own overflow branch) —
+        # the unique-overflow branch itself reads only `budget` rows
+        jaxpr = _jax.make_jaxpr(f._lookup_tiered_raw)(
+            f.device_part, host, ids, f.feature_order)
+        host_shape = tuple(host.shape)
+
+        def gathers(jxp, depth):
+            out = []
+            for eqn in jxp.eqns:
+                if eqn.primitive.name == "cond":
+                    for br in eqn.params["branches"]:
+                        out += gathers(br.jaxpr, depth + 1)
+                elif eqn.primitive.name == "gather":
+                    if tuple(eqn.invars[0].aval.shape) == host_shape:
+                        out.append((eqn.outvars[0].aval.shape[0], depth))
+                else:
+                    for sub in eqn.params.values():
+                        if hasattr(sub, "jaxpr"):
+                            out += gathers(sub.jaxpr, depth)
+            return out
+
+        reads = gathers(jaxpr.jaxpr, 0)
+        assert all(rows == budget for rows, d in reads if d <= 1), reads
+        assert any(rows == ids.shape[0] and d >= 2
+                   for rows, d in reads), reads
+
+    def test_dedup_masked_matches_composition(self):
+        rng = np.random.default_rng(19)
+        n, dim = 200, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=100 * dim * 4,
+                       cold_budget=8, dedup_cold=True)
+        f.from_cpu_tensor(feat)
+        host = jnp.asarray(f.host_part)
+        ids_np = np.array([0, -1, 150, 150, 99, -1, 150, 100, 199, -1])
+        got = np.asarray(f._lookup_tiered(
+            f.device_part, host, jnp.asarray(ids_np),
+            f.feature_order, True))
+        want = feat[np.clip(ids_np, 0, n - 1)]
+        want[ids_np < 0] = 0.0
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_dedup_no_device_cache(self):
+        rng = np.random.default_rng(23)
+        n, dim = 150, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=0, cold_budget=16,
+                       dedup_cold=True)
+        f.from_cpu_tensor(feat)
+        assert f.device_part is None
+        host = jnp.asarray(f.host_part)
+        pool = rng.integers(0, n, 10)
+        ids = jnp.asarray(pool[rng.integers(0, 10, 80)])
+        np.testing.assert_allclose(
+            np.asarray(f._lookup_tiered(None, host, ids,
+                                        f.feature_order)),
+            feat[np.asarray(ids)], rtol=1e-6)
+
+    def test_dedup_randomized_property(self):
+        """Random hot/cold mixes x duplicate factors x budgets: dedup
+        output pinned to the naive tiered gather everywhere."""
+        rng = np.random.default_rng(29)
+        n, dim = 300, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        for budget in (4, 16, 64):
+            f = qv.Feature(device_cache_size=150 * dim * 4,
+                           cold_budget=budget, dedup_cold=True)
+            f.from_cpu_tensor(feat)
+            host = jnp.asarray(f.host_part)
+            for trial in range(6):
+                size = int(rng.integers(8, 128))
+                dup = int(rng.integers(1, 8))
+                pool = rng.integers(0, n, size=max(size // dup, 1))
+                ids = jnp.asarray(pool[rng.integers(0, pool.size, size)])
+                np.testing.assert_allclose(
+                    np.asarray(f._lookup_tiered(
+                        f.device_part, host, ids, f.feature_order)),
+                    np.asarray(f[ids]), rtol=1e-6,
+                    err_msg=f"budget={budget} trial={trial} dup={dup}")
+
+    def test_dedup_host_read_is_budget_sized(self):
+        """Same traffic-bound pin as the non-dedup test: the dedup
+        narrow path's ONLY host-tier read is the [budget, dim] unique
+        gather; the batch-sized host gather lives only inside the
+        lax.cond fallback."""
+        import jax as _jax
+        rng = np.random.default_rng(31)
+        n, dim, batch, budget = 200, 8, 64, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=80 * dim * 4,
+                       cold_budget=budget, dedup_cold=True)
+        f.from_cpu_tensor(feat)
+        assert f.host_part.shape[0] == 120
+        host = jnp.asarray(f.host_part)
+        ids = jnp.asarray(rng.integers(0, n, size=batch))
+        jaxpr = _jax.make_jaxpr(f._lookup_tiered_raw)(
+            f.device_part, host, ids, f.feature_order)
+        host_shape = tuple(host.shape)
+
+        def host_gathers(jxp, inside_cond):
+            out = []
+            for eqn in jxp.eqns:
+                if eqn.primitive.name == "cond":
+                    for br in eqn.params["branches"]:
+                        out += host_gathers(br.jaxpr, True)
+                elif eqn.primitive.name == "gather":
+                    src = eqn.invars[0].aval.shape
+                    if tuple(src) == host_shape:
+                        out.append((eqn.outvars[0].aval.shape[0],
+                                    inside_cond))
+                else:
+                    for sub in eqn.params.values():
+                        if hasattr(sub, "jaxpr"):
+                            out += host_gathers(sub.jaxpr, inside_cond)
+            return out
+
+        reads = host_gathers(jaxpr.jaxpr, False)
+        narrow = [r for r, in_cond in reads if not in_cond]
+        fallback = [r for r, in_cond in reads if in_cond]
+        assert narrow == [budget], reads
+        assert batch in fallback, reads
+
+    def test_dedup_pickle_roundtrip(self):
+        import pickle
+        rng = np.random.default_rng(37)
+        feat = rng.standard_normal((100, 4)).astype(np.float32)
+        f = qv.Feature(device_cache_size=50 * 4 * 4, cold_budget=8,
+                       dedup_cold=True)
+        f.from_cpu_tensor(feat)
+        f2 = pickle.loads(pickle.dumps(f))
+        assert f2.dedup_cold is True
+        ids = np.array([0, 99, 99, 99, 49, 75])
+        np.testing.assert_allclose(np.asarray(f2[jnp.asarray(ids)]),
+                                   feat[ids], rtol=1e-6)
 
     def test_offload_on_cpu_falls_back_loudly(self, caplog):
         import logging

@@ -115,7 +115,8 @@ class TestGspmdTrainStep:
         y = labels[seeds]
         key = jax.random.key(7)
 
-        ref_step = build_train_step(model, tx, sizes, g)
+        # donate=False: state is re-sharded for the TP arm after this call
+        ref_step = build_train_step(model, tx, sizes, g, donate=False)
         ref_state, ref_loss = ref_step(state, feat, None, indptr, indices,
                                        seeds, y, key)
 
@@ -142,7 +143,7 @@ class TestGspmdTrainStep:
         y = labels[seeds]
         key = jax.random.key(13)
         ref_step = build_train_step(model, tx, sizes, bs,
-                                    method="rotation")
+                                    method="rotation", donate=False)
         _, ref_loss = ref_step(state, feat, None, indptr, indices, seeds,
                                y, key, rows)
         tp_step = build_gspmd_train_step(model, tx, sizes, mesh,

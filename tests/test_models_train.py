@@ -124,7 +124,9 @@ class TestSingleChipTraining:
         topo, model, tx, state, feat, labels = _setup(planted, sizes, bs)
         perm_feat, new_order = qv.reindex_by_config(topo, np.asarray(feat),
                                                     0.5)
-        step = build_train_step(model, tx, sizes, bs)
+        # donate=False: this test deliberately replays ONE state through
+        # two step calls (the donated default would delete it)
+        step = build_train_step(model, tx, sizes, bs, donate=False)
         indptr, indices = jnp.asarray(topo.indptr), jnp.asarray(topo.indices)
         seeds = jnp.arange(bs, dtype=jnp.int32)
         y = jnp.asarray(labels[:bs])
@@ -195,7 +197,9 @@ class TestDataParallelTraining:
                            jnp.asarray(topo.indices))
         seeds = jnp.arange(n_dev * per_dev, dtype=jnp.int32)
         y = jnp.asarray(labels[np.asarray(seeds)])
-        exact = build_e2e_train_step(model, tx, sizes, per_dev, mesh)
+        # donate=False: the same state is replayed through both arities
+        exact = build_e2e_train_step(model, tx, sizes, per_dev, mesh,
+                                     donate=False)
         rot = build_e2e_train_step(model, tx, sizes, per_dev, mesh,
                                    method="rotation")
         rows = as_index_rows(indices)

@@ -1,0 +1,333 @@
+"""Pipeline executor + train-step donation tests.
+
+The pipeline contract: results in submission order, bounded depth,
+exceptions surface at the failed item's position with the remaining
+work cancelled, close() idempotent, no thread leak. The donation
+contract: a donated step consumes its input TrainState (buffers
+deleted, outputs alias them on backends that support aliasing) and
+keeps the live-array population flat over many steps; shape/dtype
+drift fails loudly instead of silently copying."""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu.pipeline import Pipeline, pipelined
+
+
+class TestPipeline:
+    def test_map_matches_synchronous_loop(self):
+        fn = lambda x: x * x + 1
+        items = list(range(23))
+        with Pipeline(depth=2) as p:
+            got = list(p.map(fn, items))
+        assert got == [fn(x) for x in items]
+
+    def test_submit_results_in_order(self):
+        p = Pipeline(depth=3)
+        futs = [p.submit(lambda x: x + 100, i) for i in range(7)]
+        assert [f.result() for f in futs] == list(range(100, 107))
+        p.close()
+
+    def test_overlap_and_backpressure(self):
+        # the worker runs stages while the consumer is busy; submission
+        # never runs the stage inline
+        main = threading.get_ident()
+        seen = []
+
+        def stage(x):
+            seen.append(threading.get_ident())
+            time.sleep(0.02)
+            return x
+
+        with Pipeline(depth=2) as p:
+            out = list(p.map(stage, range(6)))
+        assert out == list(range(6))
+        assert main not in seen          # all stages off-thread
+        assert len(set(seen)) == 1       # ONE worker -> deterministic order
+
+    def test_mid_stream_exception_clean_shutdown(self):
+        calls = []
+
+        def stage(x):
+            calls.append(x)
+            if x == 3:
+                raise RuntimeError("stage blew up")
+            return x
+
+        p = Pipeline(depth=2)
+        got = []
+        with pytest.raises(RuntimeError, match="stage blew up"):
+            for r in p.map(stage, range(10)):
+                got.append(r)
+        assert got == [0, 1, 2]          # results before the failure
+        # the failure cancelled the not-yet-run remainder: nothing past
+        # the in-flight window ever ran
+        assert max(calls) <= 3 + 2
+        # pipeline is still usable after a stage failure...
+        assert p.submit(lambda: 7).result() == 7
+        # ...and close is clean + idempotent afterwards
+        p.close()
+        p.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            p.submit(lambda: 1)
+
+    def test_close_cancels_queued_work(self):
+        release = threading.Event()
+        ran = []
+
+        def slow(x):
+            release.wait(2)
+            ran.append(x)
+            return x
+
+        p = Pipeline(depth=3)
+        futs = [p.submit(slow, i) for i in range(3)]
+        release.set()
+        p.close(wait=True)
+        done = [f for f in futs if not f.cancelled()]
+        # whatever wasn't cancelled completed; nothing is left running
+        for f in done:
+            assert f.result() in (0, 1, 2)
+        assert not any(t.name == "quiver-pipeline" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_close_from_worker_thread(self):
+        # a stage fn may close its own pipeline (e.g. a store teardown
+        # callback) — must not raise "cannot join current thread"
+        p = Pipeline(depth=2, name="quiver-selfclose-test")
+        fut = p.submit(p.close)
+        assert fut.result() is None
+        deadline = time.time() + 2
+        while time.time() < deadline and any(
+                t.name == "quiver-selfclose-test" and t.is_alive()
+                for t in threading.enumerate()):
+            time.sleep(0.01)
+        assert p.closed
+        assert not any(t.name == "quiver-selfclose-test" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_finalizer_stops_worker_on_gc(self):
+        p = Pipeline(depth=1, name="quiver-gc-test")
+        p.submit(lambda: 1).result()
+        del p
+        gc.collect()
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            if not any(t.name == "quiver-gc-test" and t.is_alive()
+                       for t in threading.enumerate()):
+                break
+            time.sleep(0.01)
+        assert not any(t.name == "quiver-gc-test" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_pipelined_helper_closes_on_error(self):
+        with pytest.raises(ValueError):
+            list(pipelined(lambda x: (_ for _ in ()).throw(ValueError()),
+                           range(4), name="quiver-helper-test"))
+        time.sleep(0.05)
+        assert not any(t.name == "quiver-helper-test" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_feature_prefetch_close_idempotent(self, rng):
+        feat = rng.standard_normal((60, 8)).astype(np.float32)
+        f = qv.Feature(device_cache_size=30 * 8 * 4)
+        f.from_cpu_tensor(feat)
+        ids = np.array([0, 29, 30, 59])
+        np.testing.assert_allclose(np.asarray(f.prefetch(ids).result()),
+                                   feat[ids], rtol=1e-6)
+        f.close()
+        f.close()                         # idempotent
+        # prefetch after close lazily re-opens a fresh pipeline
+        np.testing.assert_allclose(np.asarray(f.prefetch(ids).result()),
+                                   feat[ids], rtol=1e-6)
+        f.close()
+
+    def test_hetero_feature_close(self, rng):
+        feats = {"a": rng.standard_normal((20, 4)).astype(np.float32),
+                 "b": rng.standard_normal((10, 4)).astype(np.float32)}
+        hf = qv.HeteroFeature.from_cpu_tensors(feats)
+        fut = hf.prefetch({"a": np.array([0, 5]), "b": None})
+        out = fut.result()
+        np.testing.assert_allclose(np.asarray(out["a"]),
+                                   feats["a"][[0, 5]], rtol=1e-6)
+        hf.close()
+        hf.close()
+
+
+def _tiny_training(rng, sizes=(3, 2), bs=8, n=120, dim=8, classes=4):
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                           masked_feature_gather)
+    deg = rng.integers(1, 7, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+    feat = jnp.asarray(rng.standard_normal((n, dim)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, classes, n).astype(np.int32))
+    indptr_j = jnp.asarray(indptr.astype(np.int32))
+    indices_j = jnp.asarray(indices)
+    model = GraphSAGE(hidden_dim=16, out_dim=classes, num_layers=2,
+                      dropout=0.0)
+    tx = optax.adam(1e-2)
+    n_id, layers = sample_multihop(indptr_j, indices_j,
+                                   jnp.arange(bs, dtype=jnp.int32),
+                                   list(sizes), jax.random.key(0))
+    state = init_state(model, tx, masked_feature_gather(feat, n_id),
+                       layers_to_adjs(layers, bs, list(sizes)),
+                       jax.random.key(1))
+    return model, tx, state, feat, labels, indptr_j, indices_j
+
+
+class TestDonation:
+    def test_step_consumes_and_aliases_state(self, rng):
+        from quiver_tpu.parallel import build_train_step
+        model, tx, state, feat, labels, indptr, indices = \
+            _tiny_training(rng)
+        step = build_train_step(model, tx, [3, 2], 8)
+        leaf = state.params["params"]["conv0"]["lin_root"]["kernel"]
+        ptr = leaf.unsafe_buffer_pointer()
+        seeds = jnp.arange(8, dtype=jnp.int32)
+        state2, loss = step(state, feat, None, indptr, indices, seeds,
+                            labels[:8], jax.random.key(2))
+        assert leaf.is_deleted()                      # input consumed
+        out_leaf = state2.params["params"]["conv0"]["lin_root"]["kernel"]
+        # CPU/TPU alias donated buffers: the update really is in place
+        assert out_leaf.unsafe_buffer_pointer() == ptr
+        assert np.isfinite(float(loss))
+
+    def test_no_per_step_state_reallocation(self, rng):
+        from quiver_tpu.parallel import build_train_step
+        model, tx, state, feat, labels, indptr, indices = \
+            _tiny_training(rng)
+        step = build_train_step(model, tx, [3, 2], 8)
+        srng = np.random.default_rng(7)
+
+        def one(state, it):
+            seeds = jnp.asarray(srng.integers(0, 120, 8, dtype=np.int32))
+            return step(state, feat, None, indptr, indices, seeds,
+                        labels[np.asarray(seeds)], jax.random.key(it))
+
+        state, _ = one(state, 0)                      # compile + settle
+        gc.collect()
+        base = len(jax.live_arrays())
+        for it in range(1, 12):
+            state, loss = one(state, it)
+        jax.block_until_ready(loss)
+        gc.collect()
+        # donated steady state: old states die as new ones are born;
+        # the live-array population must not trend upward
+        assert len(jax.live_arrays()) <= base + 8
+
+    def test_donate_false_preserves_input_state(self, rng):
+        from quiver_tpu.parallel import build_train_step
+        model, tx, state, feat, labels, indptr, indices = \
+            _tiny_training(rng)
+        step = build_train_step(model, tx, [3, 2], 8, donate=False)
+        seeds = jnp.arange(8, dtype=jnp.int32)
+        s1, l1 = step(state, feat, None, indptr, indices, seeds,
+                      labels[:8], jax.random.key(2))
+        s2, l2 = step(state, feat, None, indptr, indices, seeds,
+                      labels[:8], jax.random.key(2))   # state still alive
+        assert abs(float(l1) - float(l2)) < 1e-6
+
+    def test_donated_matches_undonated_losses(self, rng):
+        from quiver_tpu.parallel import build_train_step
+        model, tx, state, feat, labels, indptr, indices = \
+            _tiny_training(rng)
+        sd = build_train_step(model, tx, [3, 2], 8)
+        sn = build_train_step(model, tx, [3, 2], 8, donate=False)
+        seeds = jnp.arange(8, dtype=jnp.int32)
+        ld, ln = [], []
+        s_d = s_n = state
+        # two independent states with identical leaves
+        s_d = jax.tree.map(jnp.copy, state)
+        for it in range(4):
+            s_d, l1 = sd(s_d, feat, None, indptr, indices, seeds,
+                         labels[:8], jax.random.key(it))
+            s_n, l2 = sn(s_n, feat, None, indptr, indices, seeds,
+                         labels[:8], jax.random.key(it))
+            ld.append(float(l1))
+            ln.append(float(l2))
+        np.testing.assert_allclose(ld, ln, rtol=1e-6)
+
+    def test_split_step_donates(self, rng):
+        from quiver_tpu.parallel import build_split_train_step
+        model, tx, state, feat, labels, indptr, indices = \
+            _tiny_training(rng)
+        sample_fn, step_fn = build_split_train_step(model, tx, [3, 2], 8)
+        n_id, adjs = sample_fn(indptr, indices,
+                               jnp.arange(8, dtype=jnp.int32),
+                               jax.random.key(0))
+        from quiver_tpu.parallel.train import masked_feature_gather
+        x = masked_feature_gather(feat, n_id)
+        old = state.params["params"]["conv0"]["lin_root"]["kernel"]
+        state2, loss = step_fn(state, x, adjs, labels[:8],
+                               jax.random.key(1))
+        assert old.is_deleted()
+        assert np.isfinite(float(loss))
+
+    def test_guard_rejects_dtype_drift(self, rng):
+        """An optimizer whose update changes the params dtype must be
+        refused loudly at the first donated call, not silently copied
+        every step."""
+        from quiver_tpu.parallel import build_train_step
+        model, tx, state, feat, labels, indptr, indices = \
+            _tiny_training(rng)
+
+        def drift_init(params):
+            return jnp.zeros((), jnp.int32)
+
+        def drift(updates, opt_state, params=None):
+            # opt_state int32 -> float32: donation could never reuse it
+            return updates, (opt_state + 1).astype(jnp.float32)
+
+        bad_tx = optax.GradientTransformation(drift_init, drift)
+        from quiver_tpu.parallel import TrainState
+        bad_state = TrainState(state.params, bad_tx.init(state.params),
+                               state.step)
+        step = build_train_step(model, bad_tx, [3, 2], 8)
+        seeds = jnp.arange(8, dtype=jnp.int32)
+        with pytest.raises(ValueError, match="shape/dtype"):
+            step(bad_state, feat, None, indptr, indices, seeds,
+                 labels[:8], jax.random.key(2))
+        # the guard fired BEFORE donation: state is still usable
+        ok = build_train_step(model, tx, [3, 2], 8)
+        _, loss = ok(state, feat, None, indptr, indices, seeds,
+                     labels[:8], jax.random.key(2))
+        assert np.isfinite(float(loss))
+
+    def test_inference_accumulator_donation_exact(self, rng):
+        """layerwise_inference donates its window accumulator; results
+        must stay exact (vs a hand-rolled dense mean aggregation)."""
+        from quiver_tpu.inference import layerwise_inference
+        n, dim = 60, 6
+        deg = rng.integers(0, 9, n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+        x = rng.standard_normal((n, dim)).astype(np.float32)
+        w = rng.standard_normal((dim, dim)).astype(np.float32) * 0.1
+
+        def apply_layer(i, x_self, mean_nbr):
+            return x_self + mean_nbr @ jnp.asarray(w)
+
+        got = np.asarray(layerwise_inference(
+            apply_layer, jnp.asarray(indptr.astype(np.int32)),
+            jnp.asarray(indices), jnp.asarray(x), num_layers=1,
+            batch_size=16, max_degree=4))
+        want = np.empty_like(x)
+        for v in range(n):
+            nbrs = indices[indptr[v]:indptr[v + 1]]
+            mean = x[nbrs].mean(0) if nbrs.size else np.zeros(dim,
+                                                              np.float32)
+            want[v] = x[v] + mean @ w
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
