@@ -401,9 +401,28 @@ def main():
             r = store._lookup_tiered(store.device_part, host, a,
                                      store.feature_order)
         jax.block_until_ready(r)
-        return f_batch * len(batches_f) / (time.perf_counter() - t0)
+        rps = f_batch * len(batches_f) / (time.perf_counter() - t0)
+        # ---- bytes/batch, the currency feature collection is paid in
+        # (host tier + what a cross-host exchange of this batch ships).
+        # Analytic, via the ONE shared mirror of lookup_tiered's branch
+        # structure (quant.dedup_rows_read); the jaxpr-level pin for
+        # the same bound lives in tests/test_quant.py / test_feature.py
+        from quiver_tpu.ops import quant as _quant
+        row_b = _quant.row_bytes(f_dim, store.dtype_policy["cold"], 4)
+        # no csr_topo on this store -> ids are storage rows directly,
+        # so the cold-slot count is a simple threshold test
+        host_bytes = sum(
+            _quant.dedup_rows_read(
+                a, cold_count=int((_np.asarray(jax.device_get(a))
+                                   >= store.cache_rows).sum())) * row_b
+            for a in batches_f)
+        # exchange figure: the SPMD all_to_all pair for this batch
+        # shape ships one int32 request + one payload row per slot
+        exch_bytes = f_batch * (4 + row_b)
+        return rps, host_bytes / len(batches_f), exch_bytes
 
-    feature_gather_rps = measure_feature_gather()
+    feature_gather_rps, host_bytes_per_batch, exchange_bytes_per_batch = \
+        measure_feature_gather()
     out = {
         "metric": METRIC,
         "value": round(seps, 1),
@@ -423,8 +442,12 @@ def main():
         "window_mode_vs_baseline": round(window_seps / BASELINE_SEPS, 3),
         # the bandwidth half: duplicate-heavy frontier slots/sec through
         # the fused dedup tiered feature lookup (no reference baseline
-        # ratio — the reference reports GB/s on a uniform gather)
+        # ratio — the reference reports GB/s on a uniform gather), plus
+        # bytes/batch — the currency the dtype policy shrinks
+        # (benchmarks/bench_feature.py --ab-quant A/Bs the policies)
         "feature_gather_rows_per_s": round(feature_gather_rps, 1),
+        "host_bytes_per_batch": round(host_bytes_per_batch, 1),
+        "exchange_bytes_per_batch": round(exchange_bytes_per_batch, 1),
     }
     # every measured rotation config, for the record (always present so
     # log consumers never hit a missing key)

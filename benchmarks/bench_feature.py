@@ -22,9 +22,18 @@ Modes:
                unique cold node, not per frontier slot). --dup sets the
                duplicate factor (batch / distinct ids).
 
+  --ab-quant   dtype-policy A/B at EQUAL shapes: the fused dedup tiered
+               lookup under fp32 vs bf16 vs int8 tiers on the SAME id
+               streams (same batch, same cached-row count) — reports
+               gathered-rows/sec, host-tier bytes/batch, and the
+               analytic exchange bytes/batch per arm, plus the
+               int8-vs-fp32 byte-reduction and rows/s ratios (the
+               acceptance gate: >= 2x fewer host+exchange bytes at
+               rows/s parity).
+
 Usage: python benchmarks/bench_feature.py [--rows N] [--dim D]
        [--batch B] [--iters K] [--pallas] [--bf16]
-       [--tiered F] [--prefetch] [--ab-dedup] [--dup F]
+       [--tiered F] [--prefetch] [--ab-dedup] [--ab-quant] [--dup F]
 """
 
 import argparse
@@ -139,6 +148,103 @@ def run_ab_dedup(args, jax, jnp):
                                   for k, v in out.items()}}))
 
 
+def run_ab_quant(args, jax, jnp):
+    """Dtype-policy A/B: fp32 vs bf16 vs int8 tiers at equal shapes on
+    the same duplicate-heavy id streams, through the production path
+    (fused tiered lookup, dedup_cold on). Bytes are the analytic
+    per-batch traffic mirroring lookup_tiered's branch structure — the
+    jaxpr-level pins for the same bounds live in tests/test_quant.py."""
+    import quiver_tpu as qv
+    from quiver_tpu.ops import quant
+
+    rng = np.random.default_rng(0)
+    rows, dim, batch, iters = args.rows, args.dim, args.batch, args.iters
+    frac = args.tiered if args.tiered is not None else 0.25
+    dup = max(args.dup, 1.0)
+    feat = rng.standard_normal((rows, dim)).astype(np.float32)
+    cache_rows = int(rows * frac)
+
+    ids_np = []
+    for i in range(iters):
+        pool = rng.choice(rows, size=max(int(batch / dup), 1),
+                          replace=False)
+        ids_np.append(pool[rng.integers(0, pool.size, batch)]
+                      .astype(np.int64))
+    ids_dev = [jnp.asarray(a) for a in ids_np]
+
+    policies = [None, "bf16", "int8"]
+    stores = {}
+    for pol in policies:
+        # EQUAL shapes: pin the byte budget so every arm caches the
+        # same row count — the A/B isolates row WIDTH, the capacity
+        # planner's extra-rows win is reported separately by the
+        # construction log
+        f = qv.Feature(
+            device_cache_size=cache_rows * quant.row_bytes(dim, pol, 4),
+            dedup_cold=True, dtype_policy=pol)
+        f.from_cpu_tensor(feat)
+        assert f.cache_rows == cache_rows
+        stores[pol] = (f, quant.tree_map_tier(jnp.asarray, f.host_part))
+
+    elapsed = {pol: 0.0 for pol in policies}
+    for pol in policies:                          # compile every arm
+        f, host = stores[pol]
+        jax.block_until_ready(f._lookup_tiered(
+            f.device_part, host, ids_dev[0], f.feature_order))
+    for it, ids in enumerate(ids_dev):
+        # interleave arms per batch, rotating which goes first, so
+        # machine-load drift and cache warmth cancel out of the ratios
+        order = policies[it % len(policies):] + \
+            policies[:it % len(policies)]
+        for pol in order:
+            f, host = stores[pol]
+            t0 = time.perf_counter()
+            jax.block_until_ready(f._lookup_tiered(
+                f.device_part, host, ids, f.feature_order))
+            elapsed[pol] += time.perf_counter() - t0
+
+    out = {}
+    for pol in policies:
+        row_b = quant.row_bytes(dim, pol, 4)
+        # the shared analytic mirror of lookup_tiered's branch logic:
+        # `budget` host rows on the dedup narrow path and on the
+        # compaction fallback, the full batch only when the raw cold
+        # count overflows too (no csr_topo -> ids ARE storage rows)
+        host_bytes = sum(
+            quant.dedup_rows_read(
+                a, cold_count=int((a >= cache_rows).sum())) * row_b
+            for a in ids_np)
+        key = pol or "fp32"
+        out[key] = {
+            "rows_per_s": batch * iters / elapsed[pol],
+            "host_bytes_per_batch": host_bytes / iters,
+            "exchange_bytes_per_batch": batch * (4 + row_b),
+        }
+        print(f"[ab-quant cache={frac:.0%} dup={dup:g} {key}] "
+              f"{out[key]['rows_per_s'] / 1e6:.2f} Mrows/s, "
+              f"host {out[key]['host_bytes_per_batch'] / 1e6:.2f} "
+              f"MB/batch, exchange "
+              f"{out[key]['exchange_bytes_per_batch'] / 1e6:.2f} MB/batch")
+
+    fp32, int8 = out["fp32"], out["int8"]
+    byte_ratio = ((fp32["host_bytes_per_batch"]
+                   + fp32["exchange_bytes_per_batch"])
+                  / (int8["host_bytes_per_batch"]
+                     + int8["exchange_bytes_per_batch"]))
+    speed_ratio = int8["rows_per_s"] / fp32["rows_per_s"]
+    print(f"[ab-quant] int8 vs fp32: {byte_ratio:.1f}x fewer "
+          f"host+exchange bytes/batch, {speed_ratio:.2f}x rows/s")
+    print(json.dumps({
+        "bench": "ab_quant", "rows": rows, "dim": dim, "batch": batch,
+        "iters": iters, "dup": dup, "cache_frac": frac,
+        "int8_byte_reduction": round(byte_ratio, 2),
+        "int8_speed_ratio": round(speed_ratio, 3),
+        "results": {k: {kk: round(vv, 1) for kk, vv in v.items()}
+                    for k, v in out.items()}}))
+    for f, _ in stores.values():
+        f.close()
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--rows", type=int, default=2_450_000)
@@ -161,6 +267,9 @@ def main():
     p.add_argument("--ab-dedup", action="store_true",
                    help="duplicate-heavy frontier A/B: fused tiered "
                         "lookup, dedup on/off x masked on/off")
+    p.add_argument("--ab-quant", action="store_true",
+                   help="dtype-policy A/B at equal shapes: fp32 vs "
+                        "bf16 vs int8 tiers on the same id streams")
     p.add_argument("--dup", type=float, default=8.0,
                    help="with --ab-dedup: duplicate factor "
                         "(batch / distinct ids per batch)")
@@ -176,6 +285,9 @@ def main():
 
     if args.ab_dedup:
         run_ab_dedup(args, jax, jnp)
+        return
+    if args.ab_quant:
+        run_ab_quant(args, jax, jnp)
         return
 
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32

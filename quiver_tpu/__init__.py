@@ -31,7 +31,10 @@ from .partition import (
     quiver_partition_feature,
     load_quiver_feature_partition,
     partition_feature_without_replication,
+    save_quantized_feature_partition,
+    load_quantized_feature_partition,
 )
+from .ops.quant import QuantizedTensor, plan_hot_capacity
 from .hetero import HeteroCSRTopo, HeteroGraphSageSampler
 from .hetero_feature import HeteroFeature
 from .async_sampler import AsyncNeighborSampler, AsyncCudaNeighborSampler
@@ -73,6 +76,10 @@ __all__ = [
     "quiver_partition_feature",
     "load_quiver_feature_partition",
     "partition_feature_without_replication",
+    "save_quantized_feature_partition",
+    "load_quantized_feature_partition",
+    "QuantizedTensor",
+    "plan_hot_capacity",
     "HeteroCSRTopo",
     "HeteroFeature",
     "HeteroGraphSageSampler",

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
+from .ops import quant
 
 
 def get_comm_id() -> bytes:
@@ -82,25 +83,33 @@ def schedule(size_matrix: np.ndarray) -> List[List[tuple]]:
 
 
 def build_exchange_fn(mesh: Mesh, axis: str, rows_per_host: int, cap: int,
-                      dim: int, dtype=jnp.float32):
+                      dtype=None):
     """One jitted SPMD program implementing the full DistFeature exchange
     (reference comm.py:127-182's two send/recv loops + local gather):
 
       req_ids [H, H, cap]  req_ids[s, d] = local row ids host s wants of d
-      feat    [H*rows_per_host, dim] row-sharded over ``axis``
+      feat    [H*rows_per_host, dim] row-sharded over ``axis`` — a plain
+              array or a quantized-tier pytree (``ops.quant``)
       -> resp [H, H, cap, dim]  resp[s, d] = rows host s got from host d
 
     One ``all_to_all`` ships requests, a local gather reads rows, a second
     ``all_to_all`` ships responses — the reference's allreduced size matrix
-    and scheduled pair steps collapse into the collective itself.
+    and scheduled pair steps collapse into the collective itself. A
+    quantized store ships the NARROW payload + per-row sidecars through
+    the response collective and dequantizes after it, so DCN bytes per
+    row shrink with the storage width. ``dtype`` is the caller's payload
+    dtype (None = the store's own dequantized dtype — never a silent
+    fp32 default).
     """
 
     def body(req, feat):
         # local views: req [1, H, cap], feat [rows_per_host, dim]
         incoming = jax.lax.all_to_all(req, axis, split_axis=1, concat_axis=0)
         ids = jnp.clip(incoming[:, 0, :], 0, rows_per_host - 1)   # [H, cap]
-        rows = feat[ids]                                          # [H, cap, dim]
-        resp = jax.lax.all_to_all(rows, axis, split_axis=0, concat_axis=0)
+        ship = lambda leaf: jax.lax.all_to_all(
+            leaf[ids], axis, split_axis=0, concat_axis=0)
+        # quantized payloads cross the collective narrow; dequant AFTER
+        resp = quant.dequantize(quant.tree_map_tier(ship, feat), dtype)
         return resp[None]                                         # [1,H,cap,dim]
 
     mapped = shard_map(
@@ -112,23 +121,30 @@ def build_exchange_fn(mesh: Mesh, axis: str, rows_per_host: int, cap: int,
 
 
 def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
-                      feat: jax.Array, axis: str, h_count: int,
-                      rows_per_host: int, dtype=jnp.float32, rep=None):
+                      feat, axis: str, h_count: int,
+                      rows_per_host: int, dtype=None, rep=None):
     """The per-shard body of the fused DistFeature lookup — callable from
     INSIDE any ``shard_map`` over ``axis`` (e.g. the multi-host fused
     train step composes it with sampling and the model step):
 
       ids  [B] this shard's global node ids, -1 fill
       g2h/loc [N] replicated owner / local-row maps
-      feat [rows_per_host, dim] this shard's rows
+      feat [rows_per_host, dim] this shard's rows — a plain array or a
+           quantized-tier pytree (``ops.quant.QuantizedTensor``)
       -> [B, dim] feature rows (zeros at -1 fill)
 
     Bucket ids by owner (one-hot + cumsum), scatter into a [H, B]
     request block, one ``all_to_all`` ships requests, a local gather
     reads rows, a second ``all_to_all`` ships responses, and a final
-    gather unbuckets them into batch order. ``rep`` optionally carries
+    gather unbuckets them into batch order. A quantized ``feat`` ships
+    the narrow rows + per-row sidecars through the response collective
+    and dequantizes only the [B, dim] unbucketed result — the exchange
+    moves storage-width bytes, not fp32. ``rep`` optionally carries
     (is_rep [N], rep_rank [N], bases [H]) for replicated-node
-    resolution against the calling host's replica tail."""
+    resolution against the calling host's replica tail. ``dtype`` is
+    the output dtype; None (the default) uses the store's own
+    dequantized dtype — a bf16 store must never silently upcast
+    through a hardcoded fp32 here."""
     batch = ids.shape[0]
     valid = ids >= 0
     safe = jnp.clip(ids, 0)
@@ -155,15 +171,24 @@ def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
         owner_idx, my_pos].set(local, mode="drop")
     incoming = jax.lax.all_to_all(
         req, axis, split_axis=0, concat_axis=0)             # [H, B]
-    rows = feat[jnp.clip(incoming, 0, rows_per_host - 1)]   # [H, B, d]
-    resp = jax.lax.all_to_all(
-        rows, axis, split_axis=0, concat_axis=0)            # [H, B, d]
-    out = resp[jnp.clip(owner, 0), my_pos]                  # [B, d]
+    read = jnp.clip(incoming, 0, rows_per_host - 1)
+
+    def ship(leaf):
+        rows = leaf[read]                                   # [H, B, d]
+        resp = jax.lax.all_to_all(
+            rows, axis, split_axis=0, concat_axis=0)        # [H, B, d]
+        return resp[jnp.clip(owner, 0), my_pos]             # [B, d]
+
+    # narrow payload + sidecars cross the collective; dequant happens
+    # on the [B, d] unbucketed result, after the exchange
+    out = quant.dequantize(quant.tree_map_tier(ship, feat))
+    if dtype is None:
+        dtype = out.dtype
     return jnp.where(valid[:, None], out, 0).astype(dtype)
 
 
 def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
-                         batch_per_host: int, dim: int, dtype=jnp.float32,
+                         batch_per_host: int, dtype=None,
                          with_replicate: bool = False):
     """The WHOLE DistFeature lookup as one jitted SPMD program
     (reference feature.py:555-567 dispatch + comm.py:127-182 exchange +
@@ -172,8 +197,13 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
       ids  [H*B] global node ids, -1 fill, sharded over ``axis``
       g2h  [N]   node -> owning host            (replicated)
       loc  [N]   node -> local row on its owner (replicated)
-      feat [H*rows_per_host, dim] row-sharded over ``axis``
-      -> out [H*B, dim] sharded over ``axis`` (zeros at -1 fill)
+      feat [H*rows_per_host, dim] row-sharded over ``axis`` — a plain
+           array or a quantized-tier pytree (the P(axis) spec applies
+           leaf-wise as a pytree prefix, so int8 rows and their
+           sidecars shard together and the exchange ships narrow)
+      -> out [H*B, dim] sharded over ``axis`` (zeros at -1 fill);
+         dtype = the store's dequantized dtype unless ``dtype`` is
+         given explicitly (no silent fp32 default)
 
     Per shard: bucket ids by owner (one-hot + cumsum — jittable, no host
     round trip), scatter into a [H, B] request block, one ``all_to_all``
@@ -269,11 +299,13 @@ class TpuComm:
         if self.mesh is None:
             raise ValueError("exchange_spmd needs a mesh")
         h = self.mesh.shape[self.axis]
-        rows = feat.shape[0] // h
-        key = (rows, cap, feat.shape[1], feat.dtype)
+        rows = quant.tier_rows(feat) // h
+        # the store's ACTUAL payload dtype keys (and parameterizes) the
+        # program — a bf16 or quantized store never upcasts to fp32
+        key = (rows, cap, quant.tier_key(feat))
         fn = self._exchange_fns.get(key)
         if fn is None:
             fn = build_exchange_fn(self.mesh, self.axis, rows, cap,
-                                   feat.shape[1], feat.dtype)
+                                   quant.tier_dtype(feat))
             self._exchange_fns[key] = fn
         return fn(req_ids, feat)

@@ -33,10 +33,14 @@ class HeteroFeature:
     from ``configs[node_type]`` overlaid on ``default`` (both plain
     kwarg dicts for :class:`Feature` — ``device_cache_size``,
     ``cache_policy``, ``csr_topo``, ``mesh``, ``dtype``,
-    ``host_placement``, ``cold_budget``, ``dedup_cold``...). Hetero
-    frontiers repeat hub nodes across relations, so
-    ``default={"dedup_cold": True}`` bounds every type's host-tier
-    traffic by its unique cold nodes.
+    ``host_placement``, ``cold_budget``, ``dedup_cold``,
+    ``dtype_policy``...). Hetero frontiers repeat hub nodes across
+    relations, so ``default={"dedup_cold": True}`` bounds every type's
+    host-tier traffic by its unique cold nodes — and because the knobs
+    are per type, a MAG240M-shaped config can store the 100M-row paper
+    matrix int8 (quarter the host bytes, fused dequant) while the
+    small author/institution matrices stay fp32 in HBM:
+    ``configs={"paper": {"dtype_policy": "int8"}}``.
     """
 
     def __init__(self, stores: Dict[str, Feature]):

@@ -20,6 +20,8 @@ from .sample import (
 )
 from .sample_multihop import sample_multihop, sample_multihop_dedup
 from .dedup import unique_within_budget, dedup_take
+from . import quant
+from .quant import QuantizedTensor, HotPlan, plan_hot_capacity
 from .random_walk import random_walk, random_walk_step
 from .weighted import (
     sample_layer_weighted,
@@ -49,6 +51,10 @@ __all__ = [
     "sample_multihop_dedup",
     "unique_within_budget",
     "dedup_take",
+    "quant",
+    "QuantizedTensor",
+    "HotPlan",
+    "plan_hot_capacity",
     "random_walk",
     "random_walk_step",
     "sample_layer_weighted",

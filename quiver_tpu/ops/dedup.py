@@ -80,20 +80,24 @@ def dedup_take(table: jax.Array, ids: jax.Array, budget: int,
     Pays off when ``table`` lives in a slow tier (pinned host memory)
     and ``ids`` carries duplicates (frontier duplicate factor > ~1.3);
     a duplicate-free batch degenerates to the same bytes as the plain
-    gather plus one sort.
+    gather plus one sort. ``table`` may be a quantized tier
+    (``ops.quant.QuantizedTensor``): the narrow path then reads
+    [budget, dim] int8 + sidecars and dequantizes only the unique rows.
     """
+    from . import quant
     n = ids.shape[0]
-    rows = table.shape[0]
+    rows = quant.tier_rows(table)
+    take = lambda t_ids: quant.gather_rows(
+        table, jnp.clip(t_ids, 0, max(rows - 1, 0)))
     if budget >= n:
-        return jnp.take(table, jnp.clip(ids, 0, max(rows - 1, 0)), axis=0)
+        return take(ids)
     uniq, inv, n_uniq = unique_within_budget(ids, budget, valid=valid)
 
     def narrow(_):
-        uniq_rows = jnp.take(table, jnp.clip(uniq, 0, max(rows - 1, 0)),
-                             axis=0)                    # [budget, dim]
+        uniq_rows = take(uniq)                          # [budget, dim]
         return jnp.take(uniq_rows, inv, axis=0)
 
     def full(_):
-        return jnp.take(table, jnp.clip(ids, 0, max(rows - 1, 0)), axis=0)
+        return take(ids)
 
     return jax.lax.cond(n_uniq > budget, full, narrow, None)

@@ -50,7 +50,10 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
     key[, indices_rows][, is_rep, rep_rank, bases]) -> (state, loss).
 
     ``spmd_feat`` [H*rows_per_host, dim] is the partition-sharded store
-    (``DistFeature.from_partition``'s layout — pass ``dist._spmd_feat``);
+    (``DistFeature.from_partition``'s layout — pass ``dist._spmd_feat``;
+    a ``dtype_policy`` store passes its QuantizedTensor pytree whole:
+    the P(axis) spec shards its leaves together and the exchange ships
+    the narrow payload, dequantizing after the collective);
     ``g2h``/``g2l`` the replicated owner / local-row maps
     (``PartitionInfo.global2host/global2local``); ``seeds``/``labels``
     [H*per_host_batch] sharded over ``axis``; topology replicated.
@@ -77,9 +80,12 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
             key = jax.random.fold_in(key, jax.lax.axis_index(axis))
 
             def gather(feat_, n_id, _forder):
+                # dtype=None: the lookup resolves the store's own
+                # dequantized dtype — a bf16 or quantized spmd_feat
+                # must not upcast through an fp32 default, and a
+                # QuantizedTensor has no .dtype to pass anyway
                 return dist_lookup_local(n_id, g2h, g2l, feat_, axis,
                                          h_count, rows_per_host,
-                                         dtype=feat_.dtype,
                                          rep=rep or None)
 
             loss, grads = jax.value_and_grad(

@@ -51,20 +51,25 @@ def layers_to_adjs(layers, batch_size: int, sizes: Sequence[int]):
     return adjs[::-1]
 
 
-def masked_feature_gather(feat: jax.Array, n_id: jax.Array,
+def masked_feature_gather(feat, n_id: jax.Array,
                           feature_order=None) -> jax.Array:
     """Feature rows for a -1-padded frontier, through the optional
     hot-order indirection (reference feature.py:296-301); padded rows
-    come back zeroed so aggregation stays exact."""
+    come back zeroed so aggregation stays exact. ``feat`` may be a
+    plain array or a quantized store (``ops.quant`` — e.g.
+    ``quant.quantize(feat, "int8")``): dequantization fuses into the
+    gather, so the step reads narrow rows + sidecars and the model
+    consumes float activations unchanged."""
+    from ..ops import quant
     ids = n_id
     if feature_order is not None:
         ids = feature_order[jnp.clip(n_id, 0)]
-    safe = jnp.clip(ids, 0, feat.shape[0] - 1)
-    x = jnp.take(feat, safe, axis=0)
+    safe = jnp.clip(ids, 0, quant.tier_rows(feat) - 1)
+    x = quant.gather_rows(feat, safe)
     return x * (n_id >= 0).astype(x.dtype)[:, None]
 
 
-def dedup_feature_gather(feat: jax.Array, n_id: jax.Array,
+def dedup_feature_gather(feat, n_id: jax.Array,
                          feature_order=None,
                          budget: int | None = None) -> jax.Array:
     """``masked_feature_gather`` reading each distinct valid id ONCE:
@@ -75,9 +80,10 @@ def dedup_feature_gather(feat: jax.Array, n_id: jax.Array,
     the unique count overflows — identical output in every case.
     Default budget: ``max(len(n_id)//4, 256)``."""
     from ..ops.dedup import unique_within_budget
+    from ..ops.quant import default_cold_budget
     n = n_id.shape[0]
     if budget is None:
-        budget = max(n // 4, 256)
+        budget = default_cold_budget(n)
     if budget >= n:
         return masked_feature_gather(feat, n_id, feature_order)
     valid = n_id >= 0
@@ -232,7 +238,9 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
     is sized from the graph's degree-bucket split. ``dedup_gather``
     (True or an int unique budget) swaps the frontier feature gather
     for ``dedup_feature_gather`` — one read per distinct node instead
-    of per frontier slot."""
+    of per frontier slot. ``feat`` may be a quantized store
+    (``ops.quant.quantize(feat, "int8"|"bf16")``): dequant fuses into
+    the gather and the model consumes float activations unchanged."""
     sizes = list(sizes)
     gather = _dedup_gather_fn(dedup_gather)
 
@@ -280,7 +288,9 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     ``hub_frac`` (cached ``CSRTopo.exact_bucket_meta().frac``) sizes the
     wide-exact hub budget when exact mode gets an ``indices_rows``.
     ``dedup_gather`` (True or an int unique budget) swaps each shard's
-    frontier feature gather for ``dedup_feature_gather``."""
+    frontier feature gather for ``dedup_feature_gather``. ``feat`` may
+    be a quantized store (``ops.quant``) — the P() spec broadcasts
+    over its leaves as a pytree prefix."""
     sizes = list(sizes)
     gather = _dedup_gather_fn(dedup_gather)
 

@@ -14,12 +14,14 @@ replication; plus the on-disk result layout and loader. Differences:
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 from typing import List, Sequence
 
 import numpy as np
 
+from .ops import quant
 from .utils import parse_size
 
 QUIVER_MAGIC_NUMBER = 256
@@ -116,3 +118,86 @@ def load_quiver_feature_partition(partition_idx: int, result_path: str):
     partition_book = np.load(
         os.path.join(result_path, "feature_partition_book.npy"))
     return partition_book, partition_res, cache_res
+
+
+# -- quantized feature artifacts ------------------------------------------
+# Offline preprocessing is where a dtype policy pays twice: the on-disk
+# artifact shrinks 2-4x (so do load times) AND a loaded partition is
+# already in the width its serving tier wants — no per-boot requantize.
+_DTYPE_META = "dtype_meta.json"
+
+
+def save_quantized_feature_partition(feat, partition_res, result_path: str,
+                                     dtype_policy="int8",
+                                     overwrite: bool = False):
+    """Persist each partition's feature rows UNDER a dtype policy, next
+    to the partition-index layout of :func:`quiver_partition_feature`:
+
+        result_path/feature_partition_{i}/feature_rows.npy
+        result_path/feature_partition_{i}/feature_scale.npy  (int8 only)
+        result_path/feature_partition_{i}/feature_zero.npy   (int8 only)
+        result_path/feature_partition_{i}/dtype_meta.json
+
+    ``partition_res`` is the per-partition id arrays (the partitioner's
+    first return); rows are stored in partition-local order, so
+    ``load_quantized_feature_partition(i, path)`` hands back exactly
+    the arrays ``Feature.from_mmap`` / ``DistFeature.from_partition``
+    want, scales and zero-points included. ``dtype_meta.json`` records
+    the policy, storage dtype, logical dtype and shape, so a loader
+    can refuse a policy mismatch instead of mis-decoding bytes."""
+    policy = quant.resolve_policy(dtype_policy)
+    feat = np.asarray(feat)
+    for i, ids in enumerate(partition_res):
+        part_dir = os.path.join(result_path, f"feature_partition_{i}")
+        os.makedirs(part_dir, exist_ok=True)
+        target = os.path.join(part_dir, "feature_rows.npy")
+        if os.path.exists(target) and not overwrite:
+            raise FileExistsError(
+                f"{target} exists; pass overwrite=True to replace it")
+        q = quant.quantize(feat[np.asarray(ids)], policy)
+        meta = {"dtype_policy": policy or "fp32",
+                "logical_dtype": str(feat.dtype),
+                "rows": int(np.asarray(ids).shape[0]),
+                "dim": int(feat.shape[1])}
+        if quant.is_quantized(q):
+            np.save(target, q.data)
+            np.save(os.path.join(part_dir, "feature_scale.npy"), q.scale)
+            np.save(os.path.join(part_dir, "feature_zero.npy"), q.zero)
+            meta["storage_dtype"] = str(q.data.dtype)
+            meta["sidecar_dtype"] = str(q.scale.dtype)
+        else:
+            arr = np.ascontiguousarray(q)
+            meta["storage_dtype"] = str(arr.dtype)
+            if arr.dtype.kind == "V" or str(arr.dtype) == "bfloat16":
+                # np.save writes ml_dtypes arrays as raw void bytes and
+                # np.load can't rebuild the dtype — persist the bit
+                # pattern as uint16 and re-view on load (dtype_meta
+                # records the real storage dtype)
+                arr = arr.view(np.uint16)
+            np.save(target, arr)
+        with open(os.path.join(part_dir, _DTYPE_META), "w") as fh:
+            json.dump(meta, fh)
+
+
+def load_quantized_feature_partition(partition_idx: int, result_path: str,
+                                     mmap: bool = False):
+    """Load one partition's persisted rows. Returns ``(tier, meta)``
+    where ``tier`` is a plain array (fp32/bf16/fp16 policies) or a
+    numpy :class:`~quiver_tpu.ops.quant.QuantizedTensor` (int8) ready
+    to hand to the tier machinery; ``mmap=True`` memory-maps the row
+    file (sidecars are tiny and load resident) — pair with
+    ``Feature.set_mmap_file(rows_path, disk_map, scale, zero)`` for the
+    quantized DISK tier."""
+    part_dir = os.path.join(result_path, f"feature_partition_{partition_idx}")
+    with open(os.path.join(part_dir, _DTYPE_META)) as fh:
+        meta = json.load(fh)
+    rows = np.load(os.path.join(part_dir, "feature_rows.npy"),
+                   mmap_mode="r" if mmap else None)
+    if meta["dtype_policy"] != "int8":
+        if meta["storage_dtype"] == "bfloat16":
+            import ml_dtypes
+            rows = rows.view(ml_dtypes.bfloat16)
+        return rows, meta
+    scale = np.load(os.path.join(part_dir, "feature_scale.npy"))
+    zero = np.load(os.path.join(part_dir, "feature_zero.npy"))
+    return quant.QuantizedTensor(rows, scale, zero), meta

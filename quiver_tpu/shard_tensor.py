@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .ops import quant
 from .utils import parse_size
 
 
@@ -56,17 +57,36 @@ class _Shard:
         self.base = base
 
 
+def _cat_tier(prev, new, xp):
+    """Concatenate two tier blocks leaf-wise (quantized sidecars grow
+    with the data)."""
+    if prev is None:
+        return new
+    if quant.is_quantized(new):
+        return quant.QuantizedTensor(
+            *(xp.concatenate([a, b]) for a, b in zip(prev, new)))
+    return xp.concatenate([prev, new])
+
+
 class ShardTensor:
     def __init__(self, current_device: int = 0,
-                 shard_tensor_config: Optional[ShardTensorConfig] = None):
+                 shard_tensor_config: Optional[ShardTensorConfig] = None,
+                 dtype_policy=None):
         self.current_device = current_device
         self.config = shard_tensor_config or ShardTensorConfig({})
+        # dtype_policy ("bf16"/"fp16"/"int8"): appended blocks are
+        # stored NARROW (int8 adds per-row scale/zero sidecars) and the
+        # bucketed gather dequantizes only the gathered rows — the
+        # reference hardcodes fp32 (quiver_feature.cu:65-74); here even
+        # the host tier's traffic shrinks with the storage width
+        self.dtype_policy = quant.resolve_policy(dtype_policy)
         self._shards: List[_Shard] = []
         self._offsets = [0]
         self._dim = None
-        self._dtype = None
-        self._dev_data: Dict[int, jax.Array] = {}   # device -> group storage
-        self._host_data: Optional[np.ndarray] = None
+        self._dtype = None             # INPUT dtype (append validation)
+        self._out_dtype = None         # dequantized lookup dtype
+        self._dev_data: Dict[int, object] = {}   # device -> group storage
+        self._host_data = None
         self._index = None             # small lookup arrays, rebuilt on append
 
     # -- construction -------------------------------------------------------
@@ -88,20 +108,23 @@ class ShardTensor:
             raise ValueError(
                 f"inconsistent dtype: store is {self._dtype}, "
                 f"append is {arr.dtype}")
+        block = quant.quantize(arr, self.dtype_policy)
+        if self._out_dtype is None:
+            self._out_dtype = quant.tier_dtype(block)
         if device >= 0:
             devs = jax.devices()
             key = device % len(devs)
-            arr = jax.device_put(arr, devs[key])
+            block = quant.tree_map_tier(
+                lambda a: jax.device_put(a, devs[key]), block)
             prev = self._dev_data.get(key)
-            base = 0 if prev is None else int(prev.shape[0])
-            self._dev_data[key] = arr if prev is None else \
-                jnp.concatenate([prev, arr])
+            base = 0 if prev is None else quant.tier_rows(prev)
+            self._dev_data[key] = _cat_tier(prev, block, jnp)
             self._shards.append(_Shard(key, int(arr.shape[0]), base))
         else:
+            block = quant.tree_map_tier(np.asarray, block)
             base = 0 if self._host_data is None else \
-                int(self._host_data.shape[0])
-            self._host_data = np.asarray(arr) if self._host_data is None \
-                else np.concatenate([self._host_data, np.asarray(arr)])
+                quant.tier_rows(self._host_data)
+            self._host_data = _cat_tier(self._host_data, block, np)
             self._shards.append(_Shard(-1, int(arr.shape[0]), base))
         self._offsets.append(self._offsets[-1] + int(arr.shape[0]))
         self._index = None
@@ -144,15 +167,18 @@ class ShardTensor:
         out = None
         n_sources = len(self._dev_data) + (self._host_data is not None)
         for key, data in self._dev_data.items():
-            rows = data.shape[0]
+            rows = quant.tier_rows(data)
             hit = group == key
-            got = jnp.take(data, jnp.clip(local, 0, rows - 1), axis=0)
+            # dequant fused into the bucketed gather: only the gathered
+            # rows (narrow + sidecars) convert, never the group storage
+            got = quant.gather_rows(data, jnp.clip(local, 0, rows - 1))
             if n_sources == 1:
                 # single storage group: one gather, one masked select
                 return jnp.where(hit[:, None], got, 0)
             out = jnp.where(hit[:, None], got, 0 if out is None else out)
         if out is None:
-            out = jnp.zeros((n, self._dim), dtype=self._dtype)
+            out = jnp.zeros((n, self._dim),
+                            dtype=self._out_dtype or self._dtype)
         if self._host_data is not None:
             ids_np = np.asarray(jax.device_get(ids_j)).astype(np.int64)
             ok = (ids_np >= 0) & (ids_np < total)
@@ -164,7 +190,9 @@ class ShardTensor:
                 local_np = (ids_np[host_pos]
                             - ix["offsets"][shard_np[host_pos]]
                             + ix["base"][shard_np[host_pos]])
-                got = jax.device_put(self._host_data[local_np])
+                got = jax.device_put(
+                    quant.take_np(self._host_data,
+                                  local_np).astype(out.dtype))
                 out = out.at[jnp.asarray(host_pos)].set(got)
         return out
 
@@ -178,7 +206,10 @@ class ShardTensor:
 
     def _shard_data(self, s: _Shard):
         store = self._host_data if s.device < 0 else self._dev_data[s.device]
-        return store[s.base:s.base + s.rows]
+        # dequantized view: share_ipc/device_tensor_list consumers see
+        # row values, whatever the storage width
+        return quant.dequantize(quant.tree_map_tier(
+            lambda a: a[s.base:s.base + s.rows], store))
 
     @property
     def device_tensor_list(self):
@@ -187,17 +218,29 @@ class ShardTensor:
     @property
     def cpu_tensor(self):
         # a copy, matching the old concatenate-built return: callers may
-        # mutate it without corrupting the backing store
-        return None if self._host_data is None else self._host_data.copy()
+        # mutate it without corrupting the backing store (a quantized
+        # host tier dequantizes — already a fresh array)
+        if self._host_data is None:
+            return None
+        out = quant.dequantize(self._host_data)
+        return out.copy() if out is self._host_data else out
 
     # -- cross-process compat (single process owns all chips on TPU) --------
     def share_ipc(self):
-        return [(self._shard_data(s), s.device, s.rows)
-                for s in self._shards]
+        # blocks travel dequantized (values, not codes); the policy
+        # rides along so the receiver re-quantizes instead of silently
+        # rebuilding the store at full logical width
+        return ([(self._shard_data(s), s.device, s.rows)
+                 for s in self._shards], self.dtype_policy)
 
     @classmethod
-    def new_from_share_ipc(cls, items, current_device: int = 0):
-        st = cls(current_device)
+    def new_from_share_ipc(cls, handle, current_device: int = 0):
+        if (isinstance(handle, tuple) and len(handle) == 2
+                and isinstance(handle[0], list)):
+            items, policy = handle
+        else:                       # pre-policy handles: bare item list
+            items, policy = handle, None
+        st = cls(current_device, dtype_policy=policy)
         for data, device, _rows in items:
             st.append(np.asarray(data) if device < 0 else data, device)
         return st

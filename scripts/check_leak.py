@@ -13,6 +13,12 @@ shapes — a shape regression there shows up as per-batch recompiles
 (unbounded executable-cache growth), which live-array counts alone
 would miss.
 
+Phase 3 repeats the pipelined-lookup loop against an int8-tier store
+(dtype_policy="int8"): the per-row scale/zero SIDECARS ride every
+gather as extra operands, so this phase pins that they leak neither
+executables (the sidecar shapes are as static as the data's) nor live
+buffers across 50 batches.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -151,6 +157,43 @@ def main():
         "device buffer leak in the pipelined/donated loop"
     dstore.close()
     print("no leak detected (phase 2: pipelined dedup + donated steps)")
+
+    # ---- phase 3: pipelined int8-tier (quantized) lookups ----
+    from quiver_tpu.ops import quant
+
+    qstore = qv.Feature(device_cache_size=n // 4 * (dim + 8),
+                        csr_topo=topo, dedup_cold=True, cold_budget=256,
+                        dtype_policy="int8")
+    qstore.from_cpu_tensor(feat)
+    qhost = quant.tree_map_tier(jnp.asarray, qstore.host_part)
+
+    def q_lookup(ids):
+        out = qstore._lookup_tiered(qstore.device_part, qhost, ids,
+                                    qstore.feature_order)
+        jax.block_until_ready(out)
+        return out
+
+    # warmup: compile the quantized lookup, settle caches
+    for _ in pipelined(q_lookup, dup_batches(3)):
+        pass
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = qstore._lookup_tiered._cache_size()
+
+    for out in pipelined(q_lookup, dup_batches(50)):
+        pass
+    del out
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = qstore._lookup_tiered._cache_size() - base_cache
+    print(f"phase 3 live arrays: {base_arrays} -> {arrays}; "
+          f"int8 lookup executable-cache growth: {grew}")
+    assert grew == 0, \
+        "quantized lookup recompiled mid-loop (sidecar shape leak)"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak in the int8-tier loop (scale/zero sidecars?)"
+    qstore.close()
+    print("no leak detected (phase 3: pipelined int8-tier lookups)")
 
 
 if __name__ == "__main__":

@@ -41,7 +41,15 @@ def test_bench_cpu_smoke_json_contract():
     for mode in ("rotation", "exact", "window"):
         assert out[f"{mode}_mode_value"] > 0
         assert out[f"{mode}_mode_vs_baseline"] is None   # not comparable
-    # the bandwidth half: dedup tiered feature-gather rows/sec
+    # the bandwidth half: dedup tiered feature-gather rows/sec + the
+    # bytes/batch currency (host tier + exchange) the dtype policy
+    # shrinks
     assert out["feature_gather_rows_per_s"] > 0
+    assert out["host_bytes_per_batch"] > 0
+    assert out["exchange_bytes_per_batch"] > 0
+    # fp32 store in the smoke config: the exchange ships one int32
+    # request + one fp32 row per slot — pin the analytic formula so the
+    # key can't silently change meaning
+    assert out["exchange_bytes_per_batch"] % (4 + 64 * 4) == 0
     assert out["vs_baseline"] is None
     assert "error" not in out

@@ -592,30 +592,12 @@ class TestOffloadHostTier:
         assert f.host_part.shape[0] == 120
         host = jnp.asarray(f.host_part)
         ids = jnp.asarray(rng.integers(0, n, size=batch))
+        from _traffic import gather_reads
         jaxpr = _jax.make_jaxpr(f._lookup_tiered_raw)(
             f.device_part, host, ids, f.feature_order)
-        host_shape = tuple(host.shape)
-
-        def host_gathers(jxp, inside_cond):
-            out = []
-            for eqn in jxp.eqns:
-                if eqn.primitive.name == "cond":
-                    for br in eqn.params["branches"]:
-                        out += host_gathers(br.jaxpr, True)
-                elif eqn.primitive.name == "gather":
-                    src = eqn.invars[0].aval.shape
-                    if tuple(src) == host_shape:
-                        out.append((eqn.outvars[0].aval.shape[0],
-                                    inside_cond))
-                else:
-                    for sub in eqn.params.values():
-                        if hasattr(sub, "jaxpr"):   # pjit / closed calls
-                            out += host_gathers(sub.jaxpr, inside_cond)
-            return out
-
-        reads = host_gathers(jaxpr.jaxpr, False)
-        narrow = [r for r, in_cond in reads if not in_cond]
-        fallback = [r for r, in_cond in reads if in_cond]
+        reads = gather_reads(jaxpr, host.shape)
+        narrow = [r for r, depth in reads if depth == 0]
+        fallback = [r for r, depth in reads if depth > 0]
         assert narrow == [budget], reads      # bounded by the budget
         assert batch in fallback, reads       # full gather only in cond
 
@@ -760,26 +742,10 @@ class TestOffloadHostTier:
         # traffic bound: every batch-sized host gather lives inside a
         # NESTED cond (the compaction fallback's own overflow branch) —
         # the unique-overflow branch itself reads only `budget` rows
+        from _traffic import gather_reads
         jaxpr = _jax.make_jaxpr(f._lookup_tiered_raw)(
             f.device_part, host, ids, f.feature_order)
-        host_shape = tuple(host.shape)
-
-        def gathers(jxp, depth):
-            out = []
-            for eqn in jxp.eqns:
-                if eqn.primitive.name == "cond":
-                    for br in eqn.params["branches"]:
-                        out += gathers(br.jaxpr, depth + 1)
-                elif eqn.primitive.name == "gather":
-                    if tuple(eqn.invars[0].aval.shape) == host_shape:
-                        out.append((eqn.outvars[0].aval.shape[0], depth))
-                else:
-                    for sub in eqn.params.values():
-                        if hasattr(sub, "jaxpr"):
-                            out += gathers(sub.jaxpr, depth)
-            return out
-
-        reads = gathers(jaxpr.jaxpr, 0)
+        reads = gather_reads(jaxpr, host.shape)
         assert all(rows == budget for rows, d in reads if d <= 1), reads
         assert any(rows == ids.shape[0] and d >= 2
                    for rows, d in reads), reads
@@ -853,30 +819,12 @@ class TestOffloadHostTier:
         assert f.host_part.shape[0] == 120
         host = jnp.asarray(f.host_part)
         ids = jnp.asarray(rng.integers(0, n, size=batch))
+        from _traffic import gather_reads
         jaxpr = _jax.make_jaxpr(f._lookup_tiered_raw)(
             f.device_part, host, ids, f.feature_order)
-        host_shape = tuple(host.shape)
-
-        def host_gathers(jxp, inside_cond):
-            out = []
-            for eqn in jxp.eqns:
-                if eqn.primitive.name == "cond":
-                    for br in eqn.params["branches"]:
-                        out += host_gathers(br.jaxpr, True)
-                elif eqn.primitive.name == "gather":
-                    src = eqn.invars[0].aval.shape
-                    if tuple(src) == host_shape:
-                        out.append((eqn.outvars[0].aval.shape[0],
-                                    inside_cond))
-                else:
-                    for sub in eqn.params.values():
-                        if hasattr(sub, "jaxpr"):
-                            out += host_gathers(sub.jaxpr, inside_cond)
-            return out
-
-        reads = host_gathers(jaxpr.jaxpr, False)
-        narrow = [r for r, in_cond in reads if not in_cond]
-        fallback = [r for r, in_cond in reads if in_cond]
+        reads = gather_reads(jaxpr, host.shape)
+        narrow = [r for r, depth in reads if depth == 0]
+        fallback = [r for r, depth in reads if depth > 0]
         assert narrow == [budget], reads
         assert batch in fallback, reads
 

@@ -138,6 +138,46 @@ class TestSingleChipTraining:
         assert abs(float(l1) - float(l2)) < 1e-5
 
 
+class TestDtypePolicyAccuracyParity:
+    def test_final_loss_parity_fp32_bf16_int8(self, planted):
+        """Tier-1 accuracy gate for the dtype policy: the SAME synthetic
+        SAGE run (same seeds, same batches) trained against fp32, bf16,
+        and int8 feature tiers must land within a small final-loss
+        delta — per-row affine int8 error (~scale/2 per element) and
+        bf16 rounding are noise at model scale, and a policy that broke
+        dequant would blow this gate wide open."""
+        from quiver_tpu.ops import quant
+        sizes, bs = [5, 3], 32
+        finals = {}
+        for pol in (None, "bf16", "int8"):
+            # fresh (deterministic) setup per arm: the donated step
+            # consumes each arm's state, and all arms must start from
+            # identical params
+            topo, model, tx, state, feat, labels = _setup(
+                planted, sizes, bs)
+            indptr = jnp.asarray(topo.indptr)
+            indices = jnp.asarray(topo.indices)
+            step = build_train_step(model, tx, sizes, bs)
+            feat_q = quant.quantize(feat, pol)
+            rng = np.random.default_rng(0)
+            n = feat.shape[0]
+            first = last = None
+            for it in range(50):
+                seeds = rng.choice(n, bs, replace=False).astype(np.int32)
+                y = jnp.asarray(labels[seeds])
+                state, loss = step(state, feat_q, None, indptr, indices,
+                                   jnp.asarray(seeds), y,
+                                   jax.random.key(it))
+                if first is None:
+                    first = float(loss)
+                last = float(loss)
+            assert last < first * 0.7, (pol, first, last)   # still learns
+            finals[pol or "fp32"] = last
+        for pol in ("bf16", "int8"):
+            delta = abs(finals[pol] - finals["fp32"])
+            assert delta < 0.15, (finals, pol)
+
+
 class TestRotationTraining:
     def test_rotation_step_learns(self, planted):
         from quiver_tpu.ops import as_index_rows, edge_row_ids, permute_csr

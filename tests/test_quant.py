@@ -1,0 +1,441 @@
+"""Dtype-policy tests: per-row int8 / bf16 tiers with fused dequant.
+
+Covers the quantization primitives, every tier path (fused offload
+lookup, numpy host path, dedup, masked, ShardTensor's bucketed gather,
+the SPMD DistFeature exchange), the bandwidth-aware hot-capacity
+planner, the persisted partition artifacts — and the BYTE-TRAFFIC pins:
+int8-tier lookups must move <= ~1/3 the host bytes of fp32 at equal
+batch shape, the quantized exchange must ship narrow payloads through
+its collectives, and a bf16 store must never silently upcast to fp32
+(the old ``dtype=jnp.float32`` default footgun)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import quiver_tpu as qv
+from quiver_tpu.ops import quant
+from _traffic import collective_payloads, tier_read_bytes
+
+
+def budget_for(pol, dim, rows):
+    """Byte budget that caches exactly ``rows`` under policy ``pol`` —
+    the equal-shape knob for cross-policy comparisons."""
+    hot = pol.get("hot") if isinstance(pol, dict) else pol
+    return rows * quant.row_bytes(dim, hot, 4)
+
+
+class TestQuantPrimitives:
+    def test_int8_roundtrip_error_bounded(self, rng):
+        x = rng.standard_normal((50, 32)).astype(np.float32) * 3.0
+        qt = quant.quantize(x, "int8")
+        assert qt.data.dtype == np.int8
+        assert qt.scale.shape == (50, 1)
+        back = quant.dequantize(qt)
+        # per-row affine: error <= scale/2 per element
+        bound = np.asarray(qt.scale) / 2 + 1e-6
+        assert (np.abs(back - x) <= bound).all()
+
+    def test_constant_rows_exact(self):
+        x = np.full((4, 8), 3.25, np.float32)
+        back = quant.dequantize(quant.quantize(x, "int8"))
+        np.testing.assert_allclose(back, x)
+
+    def test_cast_policies_are_plain_arrays(self):
+        x = np.ones((4, 8), np.float32)
+        assert quant.quantize(x, "bf16").dtype == jnp.bfloat16
+        assert quant.quantize(x, "fp16").dtype == np.float16
+        assert quant.quantize(x, None) is x
+        assert quant.quantize(x, "fp32") is x
+
+    def test_gather_rows_matches_dequant_take(self, rng):
+        x = rng.standard_normal((30, 8)).astype(np.float32)
+        qt = quant.quantize(jnp.asarray(x), "int8")
+        ids = jnp.asarray([0, 7, 7, 29])
+        np.testing.assert_allclose(
+            np.asarray(quant.gather_rows(qt, ids)),
+            np.asarray(quant.dequantize(qt))[np.asarray(ids)], rtol=1e-6)
+        # numpy host-path equivalent
+        qn = quant.quantize(x, "int8")
+        np.testing.assert_allclose(quant.take_np(qn, np.asarray(ids)),
+                                   quant.dequantize(qn)[np.asarray(ids)],
+                                   rtol=1e-6)
+
+    def test_int8_preserves_logical_dtype(self, rng):
+        """Sidecars carry the store's LOGICAL dtype: quantizing a bf16
+        store to int8 must dequantize back to bf16 everywhere (jnp
+        gather, np host path, dequantize) — not silently upcast every
+        lookup to fp32."""
+        x = rng.standard_normal((20, 8)).astype(np.float32) \
+            .astype(jnp.bfloat16)
+        qt = quant.quantize(x, "int8")
+        assert quant.tier_dtype(qt) == jnp.bfloat16
+        assert quant.dequantize(qt).dtype == jnp.bfloat16
+        assert quant.take_np(qt, np.array([0, 3])).dtype == jnp.bfloat16
+        assert quant.gather_rows(
+            quant.tree_map_tier(jnp.asarray, qt),
+            jnp.asarray([0, 3])).dtype == jnp.bfloat16
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="dtype policy"):
+            quant.resolve_policy("int4")
+
+
+class TestHotCapacityPlanner:
+    def test_rows_scale_with_row_bytes(self):
+        dim = 64
+        budget = 100 * dim * 4              # 100 fp32 rows
+        p32 = quant.plan_hot_capacity(budget, 10_000, dim, None)
+        pbf = quant.plan_hot_capacity(budget, 10_000, dim, "bf16")
+        p8 = quant.plan_hot_capacity(budget, 10_000, dim, "int8")
+        assert p32.rows == 100
+        assert pbf.rows == 200              # half the row bytes
+        # int8 rows cost dim + 8 sidecar bytes
+        assert p8.rows == budget // (dim + 8)
+        assert p8.rows > 3 * p32.rows
+        assert p8.fp32_rows == p32.rows
+
+    def test_hit_rate_from_degree_mass(self):
+        deg = np.array([100, 50, 10, 5, 1, 1, 1, 1], np.float64)
+        dim = 8
+        plan = quant.plan_hot_capacity(2 * dim * 4, 8, dim, None,
+                                       degree=deg)
+        # 2 fp32 rows cache the top-2 degree mass: 150/169
+        assert abs(plan.expected_hit_rate - 150 / 169) < 1e-9
+        plan8 = quant.plan_hot_capacity(2 * dim * 4, 8, dim, "int8",
+                                        degree=deg)
+        assert plan8.rows == 4              # 64B / 16B-per-row
+        assert plan8.expected_hit_rate > plan8.fp32_hit_rate
+
+    def test_feature_sizing_is_width_aware(self, rng):
+        # the SAME byte budget caches ~4x more rows under int8
+        n, dim = 400, 56                    # int8 row = 64B, fp32 = 224B
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        budget = 50 * dim * 4
+        f32 = qv.Feature(device_cache_size=budget)
+        f32.from_cpu_tensor(feat)
+        f8 = qv.Feature(device_cache_size=budget, dtype_policy="int8")
+        f8.from_cpu_tensor(feat)
+        assert f32.cache_rows == 50
+        assert f8.cache_rows == budget // (dim + 8)
+        assert f8.cache_rows >= 3 * f32.cache_rows
+
+
+POLICIES = ["bf16", "int8", {"hot": "bf16", "cold": "int8"}]
+
+
+def _tol(pol):
+    # bf16 keeps ~3 decimal digits on unit-scale data; int8 per-row
+    # affine over a ~7-sigma range lands near 0.015
+    return 0.05
+
+
+class TestFeaturePolicy:
+    @pytest.mark.parametrize("pol", POLICIES,
+                             ids=["bf16", "int8", "mixed"])
+    def test_lookup_all_paths_match_fp32(self, rng, pol):
+        n, dim = 200, 16
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=budget_for(pol, dim, 100),
+                       cold_budget=8, dtype_policy=pol)
+        f.from_cpu_tensor(feat)
+        assert f.cache_rows == 100
+        assert f.shape == (n, dim)
+        ids = np.array([0, 99, 100, 150, 199, 0, 120])
+        # numpy host path
+        got = np.asarray(f[jnp.asarray(ids)], dtype=np.float32)
+        np.testing.assert_allclose(got, feat[ids], atol=_tol(pol))
+        # fused path must agree with the numpy path bit-for-bit
+        host = quant.tree_map_tier(jnp.asarray, f.host_part)
+        fused = np.asarray(f._lookup_tiered(
+            f.device_part, host, jnp.asarray(ids), f.feature_order),
+            dtype=np.float32)
+        # atol: XLA may fuse the dequant multiply-add (FMA) where numpy
+        # rounds twice — a ~1e-7 difference, not a semantic one
+        np.testing.assert_allclose(fused, got, rtol=1e-6, atol=1e-6)
+        # masked semantics
+        mids = np.array([0, -1, 150, 199, -1])
+        gotm = np.asarray(f.getitem_masked(jnp.asarray(mids)),
+                          dtype=np.float32)
+        assert (gotm[[1, 4]] == 0).all()
+        np.testing.assert_allclose(gotm[[0, 2, 3]], feat[[0, 150, 199]],
+                                   atol=_tol(pol))
+
+    def test_bf16_policy_returns_bf16_rows(self, rng):
+        # the activation dtype IS the storage dtype for cast policies —
+        # an fp32 result here would mean a silent upcast somewhere
+        feat = rng.standard_normal((60, 8)).astype(np.float32)
+        f = qv.Feature(device_cache_size=budget_for("bf16", 8, 30),
+                       dtype_policy="bf16")
+        f.from_cpu_tensor(feat)
+        assert f[jnp.asarray([0, 40])].dtype == jnp.bfloat16
+        host = quant.tree_map_tier(jnp.asarray, f.host_part)
+        out = f._lookup_tiered(f.device_part, host, jnp.asarray([0, 40]),
+                               f.feature_order)
+        assert out.dtype == jnp.bfloat16
+
+    def test_dedup_int8_matches_naive(self, rng):
+        n, dim, budget = 200, 16, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        f = qv.Feature(device_cache_size=budget_for("int8", dim, 100),
+                       cold_budget=budget, dedup_cold=True,
+                       dtype_policy="int8")
+        f.from_cpu_tensor(feat)
+        host = quant.tree_map_tier(jnp.asarray, f.host_part)
+        pool = np.array([110, 150, 177, 199])
+        for uniq_cold in (3, budget + 5):   # narrow + overflow
+            ids = np.concatenate([
+                pool[rng.integers(0, 4, 40)] if uniq_cold <= 4 else
+                rng.integers(100, n, uniq_cold),
+                rng.integers(0, 100, 8)])
+            ids = jnp.asarray(ids)
+            got = np.asarray(f._lookup_tiered(
+                f.device_part, host, ids, f.feature_order),
+                dtype=np.float32)
+            want = np.asarray(f[ids], dtype=np.float32)  # numpy path
+            # atol: XLA FMA-fuses the dequant; numpy rounds twice
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_pickle_roundtrip_keeps_policy(self, rng):
+        import pickle
+        feat = rng.standard_normal((100, 8)).astype(np.float32)
+        f = qv.Feature(device_cache_size=budget_for("int8", 8, 50),
+                       dtype_policy="int8")
+        f.from_cpu_tensor(feat)
+        f2 = pickle.loads(pickle.dumps(f))
+        assert f2.dtype_policy == {"hot": "int8", "cold": "int8"}
+        ids = np.array([0, 99, 49, 75])
+        np.testing.assert_allclose(
+            np.asarray(f2[jnp.asarray(ids)], dtype=np.float32),
+            feat[ids], atol=0.05)
+
+    def test_hetero_feature_policy_via_default(self, rng):
+        feats = {"paper": rng.standard_normal((80, 8)).astype(np.float32),
+                 "author": rng.standard_normal((40, 8)).astype(np.float32)}
+        hf = qv.HeteroFeature.from_cpu_tensors(
+            feats, default={"dtype_policy": "int8",
+                            "device_cache_size": budget_for("int8", 8, 40)})
+        out = hf.lookup({"paper": jnp.asarray([0, -1, 79]),
+                         "author": jnp.asarray([5, 39])})
+        np.testing.assert_allclose(
+            np.asarray(out["paper"], dtype=np.float32)[[0, 2]],
+            feats["paper"][[0, 79]], atol=0.05)
+        assert (np.asarray(out["paper"], dtype=np.float32)[1] == 0).all()
+        np.testing.assert_allclose(
+            np.asarray(out["author"], dtype=np.float32),
+            feats["author"][[5, 39]], atol=0.05)
+
+
+class TestShardTensorPolicy:
+    def test_int8_two_tier_gather(self, rng):
+        data = rng.standard_normal((60, 8)).astype(np.float32)
+        st = qv.ShardTensor(0, dtype_policy="int8")
+        st.append(data[:40], 0)
+        st.append(data[40:], -1)
+        ids = rng.integers(0, 60, 33)
+        np.testing.assert_allclose(
+            np.asarray(st[jnp.asarray(ids)], dtype=np.float32),
+            data[ids], atol=0.05)
+        assert st.shape == (60, 8)
+        # dequantized views for compat consumers
+        np.testing.assert_allclose(
+            np.asarray(st.cpu_tensor, dtype=np.float32), data[40:],
+            atol=0.05)
+
+    def test_invalid_ids_still_zero(self, rng):
+        data = rng.standard_normal((20, 4)).astype(np.float32)
+        st = qv.ShardTensor(0, dtype_policy="int8")
+        st.append(data, 0)
+        ids = np.array([-1, 0, 19, 20, 500])
+        got = np.asarray(st[jnp.asarray(ids)], dtype=np.float32)
+        ok = (ids >= 0) & (ids < 20)
+        np.testing.assert_allclose(got[ok], data[ids[ok]], atol=0.05)
+        assert (got[~ok] == 0).all()
+
+
+class TestDistFeaturePolicy:
+    def _build(self, rng, dtype_policy, n=64, dim=16, hosts=8):
+        full = rng.standard_normal((n, dim)).astype(np.float32)
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        dist = qv.DistFeature.from_partition(full, info, comm,
+                                             dtype_policy=dtype_policy)
+        return dist, full, mesh
+
+    def test_int8_lookup_matches_ground_truth(self, rng):
+        dist, full, _ = self._build(rng, "int8")
+        ids = rng.integers(0, 64, size=8 * 16).astype(np.int32)
+        ids[::9] = -1
+        out = np.asarray(dist[jnp.asarray(ids)], dtype=np.float32)
+        valid = ids >= 0
+        np.testing.assert_allclose(out[valid], full[ids[valid]],
+                                   atol=0.05)
+        assert (out[~valid] == 0).all()
+
+    def test_bf16_roundtrip_no_silent_fp32_upcast(self, rng):
+        """The footgun pin: the exchange builders once defaulted to
+        dtype=jnp.float32 — a bf16 store that comes back fp32, or
+        ships an fp32 payload through the response collective, means
+        the default snuck back in."""
+        dist, full, _ = self._build(rng, "bf16")
+        ids = rng.integers(0, 64, size=8 * 8).astype(np.int32)
+        out = dist[jnp.asarray(ids)]
+        assert out.dtype == jnp.bfloat16        # no upcast at the API
+        np.testing.assert_allclose(
+            np.asarray(out, dtype=np.float32),
+            full.astype(jnp.bfloat16).astype(np.float32)[ids])
+        # and none ON THE WIRE: every row-payload collective (anything
+        # wider than the [H, B] int32 request block) must be bf16
+        fn = next(iter(dist._lookup_fns.values()))
+        payloads = collective_payloads(
+            fn, (jnp.asarray(ids), dist.info.global2host.astype(jnp.int32),
+                 dist.info.global2local, dist._spmd_feat))
+        rows = [(s, dt) for s, dt, _ in payloads if len(s) > 2]
+        assert rows, payloads
+        assert all(dt == jnp.bfloat16 for _, dt in rows), payloads
+
+    def test_int8_exchange_ships_narrow_payload(self, rng):
+        """>= 2x fewer response-collective bytes than fp32 at equal
+        shapes (int8 rows + sidecars vs fp32 rows)."""
+        dist8, _, _ = self._build(rng, "int8")
+        dist32, _, _ = self._build(rng, None)
+        ids = rng.integers(0, 64, size=8 * 16).astype(np.int32)
+        jax.block_until_ready(dist8[jnp.asarray(ids)])
+        jax.block_until_ready(dist32[jnp.asarray(ids)])
+
+        def wire_bytes(dist):
+            fn = next(iter(dist._lookup_fns.values()))
+            args = (jnp.asarray(ids),
+                    dist.info.global2host.astype(jnp.int32),
+                    dist.info.global2local, dist._spmd_feat)
+            return sum(b for s, _, b in collective_payloads(fn, args)
+                       if len(s) > 2)       # row payloads, not requests
+        assert wire_bytes(dist8) * 2 <= wire_bytes(dist32)
+
+    def test_dedup_composes_with_int8(self, rng):
+        full = rng.standard_normal((64, 8)).astype(np.float32)
+        g2h = (np.arange(64) % 8).astype(np.int32)
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        info = qv.PartitionInfo(host=0, hosts=8, global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=8, mesh=mesh, axis="host")
+        dist = qv.DistFeature.from_partition(full, info, comm,
+                                             dedup_cold=16,
+                                             dtype_policy="int8")
+        pool = rng.integers(0, 64, size=10)
+        ids = pool[rng.integers(0, 10, 8 * 16)].astype(np.int32)
+        ids[::9] = -1
+        out = np.asarray(dist[jnp.asarray(ids)], dtype=np.float32)
+        valid = ids >= 0
+        np.testing.assert_allclose(out[valid], full[ids[valid]],
+                                   atol=0.05)
+        assert (out[~valid] == 0).all()
+
+
+class TestByteTrafficPin:
+    def test_int8_host_bytes_at_most_third_of_fp32(self, rng):
+        """The satellite pin: at equal batch shape, the int8-tier fused
+        lookup's narrow-path host reads move <= ~1/3 the bytes of the
+        fp32 lookup (int8: dim + 8 sidecar bytes vs fp32: 4*dim)."""
+        # cache 180 / host 120: tier shapes must DIFFER so the jaxpr
+        # walk can tell host reads from (equal-dtype) cache reads
+        n, dim, batch = 300, 64, 96
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        ids = jnp.asarray(rng.integers(0, n, size=batch))
+
+        def host_bytes(pol):
+            f = qv.Feature(device_cache_size=budget_for(pol, dim, 180),
+                           cold_budget=16, dtype_policy=pol)
+            f.from_cpu_tensor(feat)
+            assert f.cache_rows == 180          # equal shapes across arms
+            host = quant.tree_map_tier(jnp.asarray, f.host_part)
+            return tier_read_bytes(
+                f._lookup_tiered_raw,
+                (f.device_part, host, ids, f.feature_order), host)
+
+        b32, b8 = host_bytes(None), host_bytes("int8")
+        assert b32 == 16 * dim * 4              # sanity: budget x fp32 row
+        assert b8 * 3 <= b32, (b8, b32)
+
+    def test_dedup_int8_narrow_path_bytes(self, rng):
+        """dedup_cold composes: the unique-table host read is also
+        narrow-width."""
+        n, dim, batch = 300, 64, 96
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        ids = jnp.asarray(rng.integers(0, n, size=batch))
+
+        def host_bytes(pol):
+            f = qv.Feature(device_cache_size=budget_for(pol, dim, 180),
+                           cold_budget=16, dedup_cold=True,
+                           dtype_policy=pol)
+            f.from_cpu_tensor(feat)
+            host = quant.tree_map_tier(jnp.asarray, f.host_part)
+            return tier_read_bytes(
+                f._lookup_tiered_raw,
+                (f.device_part, host, ids, f.feature_order), host)
+
+        b32, b8 = host_bytes(None), host_bytes("int8")
+        assert b8 * 3 <= b32, (b8, b32)
+
+
+class TestQuantizedArtifacts:
+    def test_save_load_roundtrip_int8(self, rng, tmp_path):
+        n, dim = 96, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        probs = [rng.random(n) for _ in range(2)]
+        path = str(tmp_path / "parts")
+        _, res, _ = qv.quiver_partition_feature(probs, path)
+        qv.save_quantized_feature_partition(feat, res, path,
+                                            dtype_policy="int8")
+        tier, meta = qv.load_quantized_feature_partition(0, path)
+        assert meta["dtype_policy"] == "int8"
+        assert meta["rows"] == len(res[0]) and meta["dim"] == dim
+        assert quant.is_quantized(tier)
+        np.testing.assert_allclose(quant.dequantize(tier),
+                                   feat[res[0]], atol=0.05)
+        # the loaded tier drops straight into the Feature machinery
+        np.testing.assert_allclose(
+            quant.take_np(tier, np.array([0, 1])), feat[res[0][:2]],
+            atol=0.05)
+
+    def test_save_load_fp32_passthrough(self, rng, tmp_path):
+        n, dim = 64, 4
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        probs = [rng.random(n) for _ in range(2)]
+        path = str(tmp_path / "parts")
+        _, res, _ = qv.quiver_partition_feature(probs, path)
+        qv.save_quantized_feature_partition(feat, res, path,
+                                            dtype_policy=None)
+        tier, meta = qv.load_quantized_feature_partition(1, path,
+                                                         mmap=True)
+        assert meta["dtype_policy"] == "fp32"
+        np.testing.assert_allclose(np.asarray(tier), feat[res[1]])
+
+    def test_save_load_bf16_reviews_dtype(self, rng, tmp_path):
+        # np.save writes ml_dtypes bfloat16 as raw void bytes; the
+        # loader must re-view it from dtype_meta, not hand back |V2
+        feat = rng.standard_normal((32, 4)).astype(np.float32)
+        res = [np.arange(16), np.arange(16, 32)]
+        path = str(tmp_path / "parts")
+        qv.save_quantized_feature_partition(feat, res, path,
+                                            dtype_policy="bf16")
+        tier, meta = qv.load_quantized_feature_partition(0, path)
+        assert tier.dtype == jnp.bfloat16
+        assert meta["storage_dtype"] == "bfloat16"
+        np.testing.assert_allclose(np.asarray(tier, dtype=np.float32),
+                                   feat[:16], atol=0.05)
+
+    def test_overwrite_guard(self, rng, tmp_path):
+        feat = rng.standard_normal((32, 4)).astype(np.float32)
+        res = [np.arange(16), np.arange(16, 32)]
+        path = str(tmp_path / "parts")
+        qv.save_quantized_feature_partition(feat, res, path)
+        with pytest.raises(FileExistsError):
+            qv.save_quantized_feature_partition(feat, res, path)
+        qv.save_quantized_feature_partition(feat, res, path,
+                                            overwrite=True)
