@@ -6,7 +6,7 @@
 #   1. probe the TPU backend every PROBE_SLEEP seconds (default 390 —
 #      off the :00/:30 marks) until it answers;
 #   2. on recovery, run the suite scripts given as arguments (default:
-#      chip_suite4.sh chip_suite5.sh);
+#      the quick headline then the full parameterized chip_suite.sh);
 #   3. transcribe the suite log's result lines into $OUT_MD
 #      (default docs/measurements_auto.md) with a RECOVERED marker;
 #   4. git-commit the log + transcription so the evidence survives the
@@ -21,7 +21,7 @@ LOG=benchmarks/chip_watch_auto.log
 OUT_MD=${OUT_MD:-docs/measurements_auto.md}
 PROBE_SLEEP=${PROBE_SLEEP:-390}
 MAX_PROBES=${MAX_PROBES:-110}
-SUITES=${*:-"benchmarks/chip_suite_quick.sh benchmarks/chip_suite4.sh benchmarks/chip_suite5.sh"}
+SUITES=${*:-"benchmarks/chip_suite_quick.sh benchmarks/chip_suite.sh"}
 
 # usability probe, not a presence probe: jax.devices() can answer while
 # the device claim is wedged (r5 lesson) — canary.py times a real

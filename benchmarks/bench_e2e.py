@@ -8,6 +8,14 @@ dispatch (lax.scan over batches).
 
 Usage: python benchmarks/bench_e2e.py [--nodes N] [--dim D] [--hidden H]
        [--batches B] [--method rotation|exact]
+
+--ab-exchange: multi-host fused dist-step A/B on the virtual 8-host
+CPU mesh — dense [H, B] exchange vs the compact deduplicated [H, cap]
+one (``exchange_cap``). Reports steps/s, the traced all_to_all payload
+bytes per step for each arm (the DCN currency; byte ratios are the
+paper-relevant result on CPU, where every link runs at memory speed),
+and exact loss parity. Runs at a reduced, CPU-sized scale with bench
+fanouts [15, 10, 5].
 """
 
 import argparse
@@ -15,7 +23,135 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_ab_exchange(args, jax):
+    """Dense [H, B] vs compact dedup'd [H, cap] fused dist-step
+    exchange, same state/seeds/keys, on the virtual CPU mesh."""
+    import json
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import quiver_tpu as qv
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.parallel import build_dist_train_step
+    from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                           masked_feature_gather)
+    from quiver_tpu.pyg.sage_sampler import layer_shapes
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from _traffic import collective_payloads
+
+    hosts = args.hosts
+    if len(jax.devices()) < hosts:
+        print(f"ab-exchange needs {hosts} devices, have "
+              f"{len(jax.devices())} (run with JAX_PLATFORMS=cpu)")
+        return 1
+    # CPU-sized: bench fanouts, reduced width/batch so the dense arm's
+    # [H, B, dim] responses stay in memory
+    n, dim, classes = 60_000, 16, 16
+    sizes, per_host = [15, 10, 5], 16
+    frontier = layer_shapes(per_host, sizes)[-1].n_id_cap
+    rng = np.random.default_rng(0)
+    deg = rng.integers(1, 25, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    g2h = rng.integers(0, hosts, n).astype(np.int32)
+    g2h[:hosts] = np.arange(hosts)
+
+    mesh = Mesh(np.array(jax.devices()[:hosts]), axis_names=("host",))
+    info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+    comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh, axis="host")
+    dist = qv.DistFeature.from_partition(feat, info, comm)
+    cap = args.exchange_cap or info.plan_exchange_cap(
+        frontier, degree=deg).cap
+
+    model = GraphSAGE(hidden_dim=args.hidden, out_dim=classes,
+                      num_layers=3, dropout=0.0)
+    tx = optax.adam(3e-3)
+    indptr_j = jnp.asarray(indptr.astype(np.int32))
+    indices_j = jnp.asarray(indices)
+    n_id, layers = sample_multihop(indptr_j, indices_j,
+                                   jnp.arange(per_host, dtype=jnp.int32),
+                                   sizes, jax.random.key(0))
+    state = init_state(model, tx,
+                       masked_feature_gather(jnp.asarray(feat), n_id),
+                       layers_to_adjs(layers, per_host, sizes),
+                       jax.random.key(1))
+    sharding = NamedSharding(mesh, P("host"))
+    g = hosts * per_host
+    labels_j = jnp.asarray(labels)
+
+    # ONE pre-drawn batch sequence shared by both arms (a stateful rng
+    # would silently hand each arm different seeds and void the parity)
+    seed_seq = [rng.integers(0, n, g, dtype=np.int32)
+                for _ in range(args.steps + 1)]
+
+    def batch(it):
+        seeds = jax.device_put(jnp.asarray(seed_seq[it]), sharding)
+        return seeds, jax.device_put(labels_j[seeds], sharding), \
+            jax.random.key(it)
+
+    common = (dist._spmd_feat, info.global2host.astype(jnp.int32),
+              info.global2local, indptr_j, indices_j)
+    arms = {}
+    losses = {}
+    for name, xcap in (("dense", None), ("compact", cap)):
+        step = build_dist_train_step(
+            model, tx, sizes, per_host, mesh,
+            rows_per_host=dist._rows_per_host, donate=False,
+            exchange_cap=xcap)
+        seeds, y, key = batch(0)
+        st, loss = step(state, *common, seeds, y, key)   # compile+warm
+        jax.block_until_ready(loss)
+        losses[name] = float(loss)
+        t0 = time.perf_counter()
+        for it in range(1, args.steps + 1):
+            seeds, y, key = batch(it)
+            st, loss = step(st, *common, seeds, y, key)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        pays = collective_payloads(step, (state,) + common +
+                                   (seeds, y, key), with_depth=True)
+        if xcap is None:
+            wire = sum(b for s, _, b, d in pays)
+        else:
+            # the narrow branch's collectives — the bytes a fitting
+            # batch actually moves (the dense fallback shapes stay in
+            # the cond's other branch)
+            wire = sum(b for s, _, b, d in pays if s[1] == cap)
+        arms[name] = {"steps_per_s": args.steps / dt,
+                      "exchange_bytes_per_batch": wire * hosts}
+
+    parity = losses["dense"] == losses["compact"]
+    ratio = (arms["dense"]["exchange_bytes_per_batch"]
+             / max(arms["compact"]["exchange_bytes_per_batch"], 1))
+    out = {"bench": "ab_exchange", "hosts": hosts, "nodes": n,
+           "dim": dim, "per_host_batch": per_host,
+           "frontier_cap": frontier, "exchange_cap": cap,
+           "loss_parity_exact": parity,
+           "dense": {k: round(v, 3) for k, v in arms["dense"].items()},
+           "compact": {k: round(v, 3)
+                       for k, v in arms["compact"].items()},
+           "exchange_bytes_ratio": round(ratio, 2)}
+    print(f"[ab-exchange H={hosts} B={frontier} cap={cap}] "
+          f"dense {arms['dense']['steps_per_s']:.2f} steps/s "
+          f"{arms['dense']['exchange_bytes_per_batch'] / 1e6:.1f} "
+          f"MB/batch | compact {arms['compact']['steps_per_s']:.2f} "
+          f"steps/s "
+          f"{arms['compact']['exchange_bytes_per_batch'] / 1e6:.2f} "
+          f"MB/batch | {ratio:.0f}x fewer exchange bytes; "
+          f"loss parity exact: {parity}")
+    print(json.dumps(out))
+    return 0 if parity else 1
 
 
 def main():
@@ -37,10 +173,33 @@ def main():
                         "~40x cheaper butterfly network")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 feature storage")
+    p.add_argument("--ab-exchange", action="store_true",
+                   help="dense vs compact dedup'd dist-step exchange "
+                        "A/B on the virtual 8-host CPU mesh")
+    p.add_argument("--hosts", type=int, default=8,
+                   help="virtual mesh hosts for --ab-exchange")
+    p.add_argument("--exchange-cap", type=int, default=0,
+                   help="pin the compact cap (0 = the degree-mass "
+                        "plan from the partition)")
+    p.add_argument("--steps", type=int, default=6,
+                   help="timed steps per arm for --ab-exchange")
     args = p.parse_args()
+
+    if args.ab_exchange:
+        # the A/B is a wire-bytes + branch-behavior benchmark: pin the
+        # virtual multi-host CPU mesh (set up BEFORE jax imports)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.hosts}").strip()
 
     from _common import configure_jax
     jax = configure_jax()
+
+    if args.ab_exchange:
+        return run_ab_exchange(args, jax)
     import jax.numpy as jnp
     import optax
     from quiver_tpu.models import GraphSAGE
@@ -159,4 +318,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

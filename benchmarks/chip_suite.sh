@@ -1,55 +1,126 @@
 #!/bin/sh
-# Run every on-chip measurement in one sweep, highest-value first, each
-# step with a generous timeout (killing a TPU process mid-claim can
-# wedge the device for a long time — prefer to let steps finish).
-# Output is unbuffered; tee everything to benchmarks/chip_suite.log.
+# THE on-chip measurement sweep (the former chip_suite{,4,5}.sh merged
+# into one parameterized script). Each step runs with a generous
+# timeout — NEVER kill a TPU process mid-claim, a killed claim can
+# wedge the device for ~30+ minutes; the per-step timeout is the only
+# reaper. Appends to benchmarks/chip_suite.log (gitignored; the
+# evidence pipeline commits it with -f).
 #
-# Usage: sh benchmarks/chip_suite.sh [quick]
-#   quick = skip the e2e epoch runs and doc micro tables (sections 6-7)
+# Usage: sh benchmarks/chip_suite.sh [section ...]
+#   sections: bench dispatch sampler gather tiered offload e2e exchange
+#             mixed hetero micro ablate
+#   default       = every section
+#   quick         = bench only (the metric of record; also warms the
+#                   compile cache for a later full sweep)
 cd "$(dirname "$0")/.."
 LOG=benchmarks/chip_suite.log
-QUICK="$1"
 . benchmarks/_suite_common.sh
 
-: > "$LOG"
+SECTIONS="${*:-bench dispatch sampler gather tiered offload e2e exchange mixed hetero micro ablate}"
+[ "$SECTIONS" = "quick" ] && SECTIONS="bench"
+
+want() {
+    case " $SECTIONS " in *" $1 "*) return 0;; *) return 1;; esac
+}
+
 date | tee -a "$LOG"
+echo "sections: $SECTIONS" | tee -a "$LOG"
 
-# 1. rotation layout decision (drives bench.py's QT_BENCH_LAYOUT default)
-step python -u benchmarks/micro_ops.py --suite layout --iters 10
+if ! canary; then
+    echo "canary: device unusable; aborting suite (re-arm via benchmarks/arm_watch.sh)" | tee -a "$LOG"
+    exit 1
+fi
 
-# 2. metric of record, both layouts
-step env QT_BENCH_LAYOUT=pair python -u bench.py
-step env QT_BENCH_LAYOUT=overlap python -u bench.py
+# metric of record: the full default sweep (pair/sort, overlap/sort,
+# overlap/butterfly; best wins, labeled) + window + exact side figures
+if want bench; then
+    step python -u bench.py
+fi
 
-# 3. per-stage profile of the production path
-step python -u benchmarks/profile_stages.py --iters 10
+# dispatch probe (now exercises the fused single-dispatch Feature path)
+if want dispatch; then
+    step python -u benchmarks/debug_dispatch.py
+fi
 
-# 4. feature gather GB/s: raw device, pallas kernel, tiered grid
-step python -u benchmarks/bench_feature.py
-step python -u benchmarks/bench_feature.py --bf16
-step python -u benchmarks/bench_feature.py --pallas
-step python -u benchmarks/bench_feature.py --tiered 1.0
-step python -u benchmarks/bench_feature.py --tiered 0.2 --batch 100000
-step python -u benchmarks/bench_feature.py --tiered 0.2 --batch 100000 --prefetch
-step python -u benchmarks/bench_feature.py --tiered 0.0 --batch 100000
-step python -u benchmarks/bench_feature.py --tiered 0.0 --batch 100000 --prefetch
+# sampling: pallas kernel vs jnp hop-1, exact scattered vs wide-fetch,
+# weighted (GAT) exact pool vs windowed draw
+if want sampler; then
+    step python -u benchmarks/bench_sampler.py --pallas
+    step python -u benchmarks/bench_sampler.py --hop1 exact
+    step python -u benchmarks/bench_sampler.py --hop1 wide
+    step python -u benchmarks/bench_sampler.py --hop1 rotation
+    step python -u benchmarks/bench_sampler.py --hop1 wexact
+    step python -u benchmarks/bench_sampler.py --hop1 wwindow
+fi
 
-# 5. pallas sampling kernel vs jnp hop-1 (apples-to-apples)
-step python -u benchmarks/bench_sampler.py --pallas
-step python -u benchmarks/bench_sampler.py --hop1 exact
-step python -u benchmarks/bench_sampler.py --hop1 rotation
+# feature gather GB/s: raw device + pallas (128-aligned and padded)
+if want gather; then
+    step python -u benchmarks/bench_feature.py
+    step python -u benchmarks/bench_feature.py --bf16
+    step python -u benchmarks/bench_feature.py --pallas
+    step python -u benchmarks/bench_feature.py --pallas --dim 128
+    step python -u benchmarks/bench_feature.py --dim 128
+fi
 
-if [ "$QUICK" != "quick" ]; then
-    # 6. end-to-end epoch seconds vs the reference's 11.1 s
+# tiered host-tier grid at tunnel-sized scale (tunnel-bound numbers,
+# recorded with that caveat)
+if want tiered; then
+    step python -u benchmarks/bench_feature.py --tiered 1.0
+    step python -u benchmarks/bench_feature.py --tiered 0.2 --rows 300000 --batch 20000 --iters 5
+    step python -u benchmarks/bench_feature.py --tiered 0.2 --rows 300000 --batch 20000 --iters 5 --prefetch
+    step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5
+    step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5 --prefetch
+fi
+
+# pinned_host cold tier: does the TPU compiler take pinned_host
+# operands, and what does the one-dispatch offload lookup buy?
+if want offload; then
+    step python -u benchmarks/host_mode_probe.py
+    step python -u benchmarks/bench_feature.py --tiered 0.2 --rows 300000 --batch 20000 --iters 5 --offload
+    step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5 --offload
+fi
+
+# end-to-end epoch seconds vs the reference's 11.1 s
+if want e2e; then
     step python -u benchmarks/bench_e2e.py --method rotation --layout overlap
+    step python -u benchmarks/bench_e2e.py --method rotation --layout overlap --shuffle butterfly
     step python -u benchmarks/bench_e2e.py --method rotation --layout pair
     step python -u benchmarks/bench_e2e.py --method window --layout overlap
     step python -u benchmarks/bench_e2e.py --method exact
     step python -u benchmarks/bench_e2e.py --method rotation --layout overlap --bf16
-    # 7. primitive/gather micro tables for the docs
+fi
+
+# fused dist-step exchange: dense [H, B] vs compact dedup'd [H, cap]
+# (multi-host wire bytes; pinned to the virtual CPU mesh — the A/B is
+# about bytes and branch behavior, not TPU latency)
+if want exchange; then
+    step env JAX_PLATFORMS=cpu python -u benchmarks/bench_e2e.py --ab-exchange
+fi
+
+# mixed sampler adaptivity: device-only vs mixed + converged split
+if want mixed; then
+    step python -u benchmarks/bench_mixed.py --sampling rotation
+    step python -u benchmarks/bench_mixed.py --sampling exact
+    step python -u benchmarks/bench_mixed.py --weighted
+fi
+
+# hetero sampler per-mode cost vs homog rotation anchor
+if want hetero; then
+    step python -u benchmarks/bench_hetero.py
+fi
+
+# primitive/gather/layout micro tables for the docs + per-stage profile
+if want micro; then
+    step python -u benchmarks/micro_ops.py --suite layout --iters 10
     step python -u benchmarks/micro_ops.py --suite gather --iters 10
     step python -u benchmarks/micro_ops.py --suite primitives --iters 10
+    step python -u benchmarks/profile_stages.py --iters 10
+fi
+
+# fused-epoch stage ablation (how much of a batch is compaction?)
+if want ablate; then
+    step python -u benchmarks/ablate.py
 fi
 
 date | tee -a "$LOG"
-echo "chip suite complete -> $LOG"
+echo "chip suite complete ($SECTIONS) -> $LOG"

@@ -5,7 +5,7 @@
 # window still yields a driver-comparable headline before the full
 # sweeps start. The 03:17 r5 recovery lasted under 30 minutes — the
 # full bench.py sweep alone may not fit one. Appends to
-# benchmarks/chip_suite.log; run chip_suite4.sh + chip_suite5.sh after.
+# benchmarks/chip_suite.log; run the full chip_suite.sh after.
 cd "$(dirname "$0")/.."
 LOG=benchmarks/chip_suite.log
 . benchmarks/_suite_common.sh

@@ -9,7 +9,7 @@ either samples fine (host-offload gather works — keep the tier) or
 raises at compile (record it; the tier then needs an explicit
 device_put stream step or must stay a loud fallback).
 
-Run on chip via chip_suite5. Small graph — the probe answers a
+Run on chip via chip_suite.sh (offload section). Small graph — the probe answers a
 compiler capability question, not a bandwidth one.
 """
 

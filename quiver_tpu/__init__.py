@@ -23,7 +23,8 @@ from .utils import (
     Topo,
     init_p2p,
 )
-from .feature import Feature, DeviceConfig, DistFeature, PartitionInfo
+from .feature import (Feature, DeviceConfig, DistFeature,
+                      ExchangeCapPlan, PartitionInfo)
 from .shard_tensor import ShardTensor, ShardTensorConfig
 from .pyg import GraphSageSampler, MixedGraphSageSampler, SampleJob
 from .comm import TpuComm, HostRankTable, get_comm_id
@@ -62,6 +63,7 @@ __all__ = [
     "Feature",
     "DeviceConfig",
     "DistFeature",
+    "ExchangeCapPlan",
     "PartitionInfo",
     "ShardTensor",
     "ShardTensorConfig",

@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
 from .ops import quant
+from .ops.dedup import I32_MAX, unique_within_budget
 
 
 def get_comm_id() -> bytes:
@@ -120,9 +121,34 @@ def build_exchange_fn(mesh: Mesh, axis: str, rows_per_host: int, cap: int,
     return jax.jit(mapped)
 
 
+def cap_for_expected_load(per_owner: float, slack: float = 1.25) -> int:
+    """THE cap-sizing formula, shared by ``default_exchange_cap`` and
+    ``PartitionInfo.plan_exchange_cap`` so the headroom term can't
+    drift between them: ``slack`` proportional headroom plus ~3-sigma
+    binomial headroom on the expected per-owner unique-request load.
+    The sqrt term is what small batches need (a 128-unique batch over
+    8 owners overflows a bare mean-sized bucket ~half the time, since
+    per-owner skew is relative to sqrt(count)); at production counts
+    it vanishes into the slack term."""
+    return max(1, int(np.ceil(slack * per_owner
+                              + 3.0 * np.sqrt(max(per_owner, 0.0)))))
+
+
+def default_exchange_cap(batch: int, hosts: int, slack: float = 1.25) -> int:
+    """Per-owner request-slot budget for the compact exchange when the
+    caller has no partition statistics: assume a multi-hop-frontier
+    duplicate factor of >= 8 (bench fanouts run 10-50x) and balanced
+    ownership, with ``slack`` headroom for per-owner skew. Callers with
+    a real partition should prefer
+    ``PartitionInfo.plan_exchange_cap`` (degree-mass-aware sizing)."""
+    uniq = max(batch // 8, hosts)
+    return min(batch, cap_for_expected_load(uniq / hosts, slack))
+
+
 def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
                       feat, axis: str, h_count: int,
-                      rows_per_host: int, dtype=None, rep=None):
+                      rows_per_host: int, dtype=None, rep=None,
+                      exchange_cap: Optional[int] = None):
     """The per-shard body of the fused DistFeature lookup — callable from
     INSIDE any ``shard_map`` over ``axis`` (e.g. the multi-host fused
     train step composes it with sampling and the model step):
@@ -133,55 +159,120 @@ def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
            quantized-tier pytree (``ops.quant.QuantizedTensor``)
       -> [B, dim] feature rows (zeros at -1 fill)
 
-    Bucket ids by owner (one-hot + cumsum), scatter into a [H, B]
+    Bucket ids by owner (one-hot + cumsum), scatter into a static
     request block, one ``all_to_all`` ships requests, a local gather
     reads rows, a second ``all_to_all`` ships responses, and a final
     gather unbuckets them into batch order. A quantized ``feat`` ships
     the narrow rows + per-row sidecars through the response collective
-    and dequantizes only the [B, dim] unbucketed result — the exchange
-    moves storage-width bytes, not fp32. ``rep`` optionally carries
+    and dequantizes only the unbucketed result — the exchange moves
+    storage-width bytes, not fp32. ``rep`` optionally carries
     (is_rep [N], rep_rank [N], bases [H]) for replicated-node
     resolution against the calling host's replica tail. ``dtype`` is
     the output dtype; None (the default) uses the store's own
     dequantized dtype — a bf16 store must never silently upcast
-    through a hardcoded fp32 here."""
+    through a hardcoded fp32 here.
+
+    ``exchange_cap`` (None = dense) switches the collectives to the
+    COMPACT deduplicated layout: the frontier's valid ids dedup once
+    into a static table (``ops.dedup.unique_within_budget``, budget
+    ``min(cap*H, B)``), the *unique* ids bucket by owner into a
+    [H, cap] request block — the same shape ``build_exchange_fn``
+    uses — and the wire carries [H, cap] requests + [H, cap, width]
+    responses instead of [H, B] / [H, B, width]; the inverse map
+    expands the unique rows back to batch order. A multi-hop frontier
+    is mostly -1 padding plus repeated hub ids, so ``B/cap``-ish fewer
+    bytes cross DCN while each distinct remote row moves exactly once.
+    When the unique count overflows the table or any per-owner bucket
+    overflows ``cap``, a ``lax.cond`` falls back to the dense path —
+    bit-identical output in every case (dequant is elementwise, so
+    expand-after-dequant equals dequant-after-expand). The overflow
+    flag is ``pmax``-reduced over ``axis`` first: the branch must be
+    UNIFORM across shards or the collectives inside it would deadlock.
+    """
     batch = ids.shape[0]
     valid = ids >= 0
-    safe = jnp.clip(ids, 0)
-    owner = jnp.where(valid, g2h[safe], -1)                 # [B]
-    local = loc[safe]                                       # [B]
-    if rep:
-        # replicated nodes resolve locally: owner := this host,
-        # local := this host's replica-tail base + rank in the set
-        is_rep, rep_rank, bases = rep
-        me = jax.lax.axis_index(axis).astype(owner.dtype)
-        r = is_rep[safe]
-        owner = jnp.where(valid & r, me, owner)
-        local = jnp.where(r, bases[me] + rep_rank[safe], local)
-    onehot = owner[None, :] == jnp.arange(
-        h_count, dtype=owner.dtype)[:, None]                # [H, B]
-    bucket_pos = jnp.cumsum(onehot, axis=1) - 1             # [H, B]
-    my_pos = jnp.sum(jnp.where(onehot, bucket_pos, 0), axis=0)  # [B]
-    # invalid (-1 fill) entries must route to a POSITIVELY
-    # out-of-bounds row: `.at[...].set(mode="drop")` resolves negative
-    # indices NumPy-style BEFORE the bounds check, so owner=-1 would
-    # silently overwrite host H-1's bucket slot 0
-    owner_idx = jnp.where(valid, owner, h_count)
-    req = jnp.zeros((h_count, batch), jnp.int32).at[
-        owner_idx, my_pos].set(local, mode="drop")
-    incoming = jax.lax.all_to_all(
-        req, axis, split_axis=0, concat_axis=0)             # [H, B]
-    read = jnp.clip(incoming, 0, rows_per_host - 1)
+    n_nodes = g2h.shape[0]
 
-    def ship(leaf):
-        rows = leaf[read]                                   # [H, B, d]
-        resp = jax.lax.all_to_all(
-            rows, axis, split_axis=0, concat_axis=0)        # [H, B, d]
-        return resp[jnp.clip(owner, 0), my_pos]             # [B, d]
+    def route(ids_, valid_):
+        """Global id -> (owning host, local row); -1 owner at invalid
+        slots (so they match no bucket). Clips from above too: the
+        compact path's unique table carries int32-max fill."""
+        safe = jnp.clip(ids_, 0, n_nodes - 1)
+        owner = jnp.where(valid_, g2h[safe], -1)
+        local = loc[safe]
+        if rep:
+            # replicated nodes resolve locally: owner := this host,
+            # local := this host's replica-tail base + rank in the set
+            is_rep, rep_rank, bases = rep
+            me = jax.lax.axis_index(axis).astype(owner.dtype)
+            r = is_rep[safe]
+            owner = jnp.where(valid_ & r, me, owner)
+            local = jnp.where(r, bases[me] + rep_rank[safe], local)
+        return owner, local
 
-    # narrow payload + sidecars cross the collective; dequant happens
-    # on the [B, d] unbucketed result, after the exchange
-    out = quant.dequantize(quant.tree_map_tier(ship, feat))
+    def bucket(owner, local, valid_, cap_):
+        """Scatter ids into a [H, cap_] per-owner request block.
+        Returns (req, my_pos, counts): counts[h] = valid ids owned by
+        h — the compact path's overflow test; slots past ``cap_`` are
+        positively out-of-bounds and dropped."""
+        onehot = owner[None, :] == jnp.arange(
+            h_count, dtype=owner.dtype)[:, None]            # [H, n]
+        bucket_pos = jnp.cumsum(onehot, axis=1) - 1         # [H, n]
+        my_pos = jnp.sum(jnp.where(onehot, bucket_pos, 0), axis=0)
+        # invalid (-1 fill) entries must route to a POSITIVELY
+        # out-of-bounds row: `.at[...].set(mode="drop")` resolves
+        # negative indices NumPy-style BEFORE the bounds check, so
+        # owner=-1 would silently overwrite host H-1's bucket slot 0
+        owner_idx = jnp.where(valid_, owner, h_count)
+        req = jnp.zeros((h_count, cap_), jnp.int32).at[
+            owner_idx, my_pos].set(local, mode="drop")
+        return req, my_pos, jnp.sum(onehot, axis=1)
+
+    def exchange(req, owner, my_pos):
+        """The collective pair: requests out, local gather, responses
+        back, unbucket to the caller's slot order ([n, dim])."""
+        incoming = jax.lax.all_to_all(
+            req, axis, split_axis=0, concat_axis=0)
+        read = jnp.clip(incoming, 0, rows_per_host - 1)
+
+        def ship(leaf):
+            rows = leaf[read]
+            resp = jax.lax.all_to_all(
+                rows, axis, split_axis=0, concat_axis=0)
+            return resp[jnp.clip(owner, 0), my_pos]
+
+        # narrow payload + sidecars cross the collective; dequant
+        # happens on the unbucketed result, after the exchange
+        return quant.dequantize(quant.tree_map_tier(ship, feat))
+
+    owner, local = route(ids, valid)
+
+    def dense(_=None):
+        req, my_pos, _counts = bucket(owner, local, valid, batch)
+        return exchange(req, owner, my_pos)
+
+    if exchange_cap is None or int(exchange_cap) >= batch:
+        out = dense()
+    else:
+        cap = int(exchange_cap)
+        u_budget = min(cap * h_count, batch)
+        uniq, inv, n_uniq = unique_within_budget(ids, u_budget,
+                                                 valid=valid)
+        u_valid = uniq != I32_MAX
+        owner_u, local_u = route(uniq, u_valid)
+        req_u, my_pos_u, counts = bucket(owner_u, local_u, u_valid, cap)
+        bad = (n_uniq > u_budget) | (jnp.max(counts) > cap)
+        # the branch carries collectives: every shard must take the
+        # same one, so one scalar pmax unifies the overflow flag
+        bad = jax.lax.pmax(bad.astype(jnp.int32), axis) > 0
+
+        def compact(_):
+            rows_u = exchange(req_u, owner_u,
+                              jnp.minimum(my_pos_u, cap - 1))
+            return jnp.take(rows_u, inv, axis=0)
+
+        out = jax.lax.cond(bad, dense, compact, None)
+
     if dtype is None:
         dtype = out.dtype
     return jnp.where(valid[:, None], out, 0).astype(dtype)
@@ -189,7 +280,8 @@ def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
 
 def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
                          batch_per_host: int, dtype=None,
-                         with_replicate: bool = False):
+                         with_replicate: bool = False,
+                         exchange_cap: Optional[int] = None):
     """The WHOLE DistFeature lookup as one jitted SPMD program
     (reference feature.py:555-567 dispatch + comm.py:127-182 exchange +
     scatter, fused):
@@ -214,13 +306,17 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
     operands (is_rep [N] bool, rep_rank [N], bases [H]) and resolves
     replicated nodes against the calling host's replica tail
     (reference feature.py:510-526's replicate override).
+
+    ``exchange_cap`` (None = dense) switches the exchange to the
+    compact deduplicated [H, cap] layout — see ``dist_lookup_local``.
     """
     h_count = mesh.shape[axis]
 
     def body(ids, g2h, loc, feat, *rep):
         return dist_lookup_local(ids.reshape(-1), g2h, loc, feat, axis,
                                  h_count, rows_per_host, dtype,
-                                 rep=rep or None)
+                                 rep=rep or None,
+                                 exchange_cap=exchange_cap)
 
     specs = (P(axis), P(), P(), P(axis))
     if with_replicate:
@@ -293,11 +389,17 @@ class TpuComm:
         return results
 
     def exchange_spmd(self, req_ids: jax.Array, feat: jax.Array,
-                      cap: int) -> jax.Array:
+                      cap: Optional[int] = None) -> jax.Array:
         """Single-controller SPMD exchange over the mesh host axis.
-        req_ids [H, H, cap] (-1 fill), feat [H*rows, dim] sharded."""
+        req_ids [H, H, cap] (-1 fill), feat [H*rows, dim] sharded.
+        ``cap`` is the per-owner request-slot budget — the knob the
+        compact fused exchange shares (``exchange_cap``); None derives
+        it from ``req_ids``'s own trailing dimension, so callers that
+        already built a capped block don't repeat themselves."""
         if self.mesh is None:
             raise ValueError("exchange_spmd needs a mesh")
+        if cap is None:
+            cap = int(req_ids.shape[-1])
         h = self.mesh.shape[self.axis]
         rows = quant.tier_rows(feat) // h
         # the store's ACTUAL payload dtype keys (and parameterizes) the

@@ -28,7 +28,7 @@ construction metadata.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -774,6 +774,17 @@ class Feature:
         return self
 
 
+class ExchangeCapPlan(NamedTuple):
+    """Degree-mass-aware sizing of the compact exchange's per-owner
+    request-slot budget (the ``exchange_cap`` knob) — the exchange
+    analogue of ``quant.plan_hot_capacity``."""
+
+    cap: int             # per-owner request slots ([H, cap] block)
+    unique_budget: int   # cap * hosts — the compact unique-table size
+    owner_frac: float    # heaviest owner's expected request share
+    balanced_cap: int    # the ownership-blind sizing, for the log
+
+
 class PartitionInfo:
     """Multi-host placement metadata (reference feature.py:461-526):
     ``global2host`` maps node -> owning host; optional per-host replicated
@@ -803,6 +814,40 @@ class PartitionInfo:
             base = self.local_sizes[self.host]
             g2l[rep] = base + np.arange(rep.size, dtype=np.int32)
         self.global2local = jnp.asarray(g2l)
+
+    def plan_exchange_cap(self, frontier_cap: int, degree=None,
+                          dup_factor: float = 8.0,
+                          slack: float = 1.25) -> ExchangeCapPlan:
+        """Size the compact exchange's per-owner request budget from
+        THIS partition's skew (the exchange analogue of
+        ``quant.plan_hot_capacity``): a frontier of ``frontier_cap``
+        slots holds roughly ``frontier_cap / dup_factor`` distinct ids
+        (multi-hop frontiers are mostly -1 padding plus repeated hubs;
+        bench fanouts run 10-50x), and each owner's share of those
+        requests is proportional to its nodes' degree mass (minibatch
+        frontiers hit nodes degree-proportionally) — or to its node
+        count when ``degree`` is omitted. ``cap`` is the heaviest
+        owner's expected unique-request load times ``slack``; pass it
+        as ``exchange_cap`` to the dist step / ``DistFeature``.
+        Overflow never costs correctness (the exchange falls back to
+        the dense block), only the traffic bound — so ``slack`` trades
+        wire bytes against fallback frequency."""
+        uniq = max(int(frontier_cap / max(dup_factor, 1.0)), self.hosts)
+        g2h = np.asarray(jax.device_get(self.global2host))
+        if degree is not None:
+            deg = np.asarray(jax.device_get(degree), np.float64)
+            mass = np.zeros(self.hosts, np.float64)
+            np.add.at(mass, g2h, deg[:g2h.shape[0]])
+        else:
+            mass = np.bincount(g2h, minlength=self.hosts).astype(
+                np.float64)
+        from .comm import cap_for_expected_load
+        frac = float(mass.max() / (mass.sum() or 1.0))
+        frac = max(frac, 1.0 / self.hosts)
+        cap = min(cap_for_expected_load(uniq * frac, slack),
+                  int(frontier_cap))
+        balanced = cap_for_expected_load(uniq / self.hosts, slack)
+        return ExchangeCapPlan(cap, cap * self.hosts, frac, balanced)
 
     def dispatch(self, ids):
         """Split request ids per owning host; replicated ids resolve
@@ -845,7 +890,7 @@ class DistFeature:
     """
 
     def __init__(self, feature: Optional[Feature], info: PartitionInfo,
-                 comm, dedup_cold=False):
+                 comm, dedup_cold=False, exchange_cap=None):
         self.feature = feature
         self.info = info
         self.comm = comm
@@ -857,6 +902,16 @@ class DistFeature:
         # whose unique count overflows fall back to the plain
         # full-batch lookup (one scalar D2H sync decides the path).
         self.dedup_cold = dedup_cold
+        # exchange_cap: run the exchange itself over the compact
+        # deduplicated [H, cap] request block (comm.dist_lookup_local)
+        # instead of the dense [H, B] one — dedup + bucketing + the
+        # overflow fallback all happen INSIDE the jitted program (no
+        # host sync; the fallback decision is a shard-uniform
+        # lax.cond). True sizes cap per batch shape
+        # (comm.default_exchange_cap); an int pins it — prefer
+        # info.plan_exchange_cap(...).cap. Composes with dedup_cold
+        # (the compact table then sees the already-unique ids).
+        self.exchange_cap = exchange_cap
         self._spmd_feat = None         # [H*rows_per_host, dim], P(axis)
         self._rows_per_host = None
         self._lookup_fns = {}
@@ -865,7 +920,8 @@ class DistFeature:
     @classmethod
     def from_partition(cls, feat, info: PartitionInfo, comm,
                        dtype=None, dedup_cold=False,
-                       dtype_policy=None) -> "DistFeature":
+                       dtype_policy=None,
+                       exchange_cap=None) -> "DistFeature":
         """Build the SPMD store from the FULL feature array + partition
         metadata: each host's rows land in its shard (replicated nodes
         also in every host's tail), row-sharded over ``comm.mesh``.
@@ -874,6 +930,10 @@ class DistFeature:
         narrow; the fused lookup then ships the NARROW payload (+ the
         int8 per-row sidecars) through both ``all_to_all`` collectives
         and dequantizes after — DCN bytes per exchanged row drop 2-4x.
+        ``exchange_cap`` (``True | int | None``) additionally compacts
+        the collectives themselves to a deduplicated [H, cap] request
+        block (see ``__init__``) — the two knobs multiply: narrow rows
+        x one crossing per distinct remote row.
         """
         if comm.mesh is None:
             raise ValueError("from_partition needs a comm with a mesh")
@@ -896,7 +956,8 @@ class DistFeature:
                 store[h, base:base + rep_rows] = feat[rep]
         axis = comm.axis
         sharding = NamedSharding(comm.mesh, P(axis))
-        self = cls(None, info, comm, dedup_cold=dedup_cold)
+        self = cls(None, info, comm, dedup_cold=dedup_cold,
+                   exchange_cap=exchange_cap)
         self._spmd_feat = quant.tree_map_tier(
             lambda a: jax.device_put(a, sharding),
             quant.quantize(store.reshape(hosts * rows_per_host, dim),
@@ -967,17 +1028,24 @@ class DistFeature:
     def _getitem_spmd_plain(self, ids):
         hosts = self.info.hosts
         b = ids.shape[0] // hosts
+        cap = self.exchange_cap
+        if cap is True:
+            from .comm import default_exchange_cap
+            cap = default_exchange_cap(b, hosts)
+        elif cap is not None:
+            cap = int(cap)
         # dtype passed EXPLICITLY from the store's payload (a bf16 or
         # quantized store must never silently upcast to an fp32 default)
         key = (b, quant.tier_key(self._spmd_feat),
-               self._rep_args is not None)
+               self._rep_args is not None, cap)
         fn = self._lookup_fns.get(key)
         if fn is None:
             from .comm import build_dist_lookup_fn
             fn = build_dist_lookup_fn(
                 self.comm.mesh, self.comm.axis, self._rows_per_host, b,
                 quant.tier_dtype(self._spmd_feat),
-                with_replicate=self._rep_args is not None)
+                with_replicate=self._rep_args is not None,
+                exchange_cap=cap)
             self._lookup_fns[key] = fn
         args = (ids, self.info.global2host.astype(jnp.int32),
                 self.info.global2local, self._spmd_feat)

@@ -18,8 +18,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_I32_MAX = jnp.iinfo(jnp.int32).max
+I32_MAX = jnp.iinfo(jnp.int32).max
+_I32_MAX = I32_MAX          # back-compat alias (fill value, public)
 
 
 def unique_within_budget(ids: jax.Array, budget: int, valid=None):
@@ -101,3 +103,30 @@ def dedup_take(table: jax.Array, ids: jax.Array, budget: int,
         return take(ids)
 
     return jax.lax.cond(n_uniq > budget, full, narrow, None)
+
+
+def compact_exchange_slots(ids, cap: int, hosts: int,
+                           owner=None) -> int:
+    """Analytic mirror of ``comm.dist_lookup_local``'s compact-exchange
+    branch structure for one shard's batch: USEFUL request slots
+    shipped per collective direction — ``cap * hosts`` on the compact
+    path, the full batch on overflow (unique valid count > the
+    ``min(cap*hosts, batch)`` table, or any per-owner bucket > cap),
+    or when ``cap`` can't beat the dense block. ``owner`` maps id ->
+    owning host (``PartitionInfo.global2host``); None models a
+    balanced hash partition (``id % hosts``). The benches' exchange
+    bytes/batch figures come from this ONE copy of the branch logic;
+    the structural (jaxpr-level) pin of the same bound lives in
+    tests/_traffic.py::collective_payloads."""
+    ids = np.asarray(jax.device_get(ids))
+    n = int(ids.shape[0])
+    if cap is None or cap >= n:
+        return n
+    uniq = np.unique(ids[ids >= 0])
+    if uniq.size > min(cap * hosts, n):
+        return n
+    own = (uniq % hosts if owner is None
+           else np.asarray(jax.device_get(owner))[uniq])
+    if np.bincount(own, minlength=hosts).max(initial=0) > cap:
+        return n
+    return cap * hosts

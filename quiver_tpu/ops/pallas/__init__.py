@@ -20,7 +20,7 @@ supports it:
 
 The kernels stay importable and interpret-mode-tested (they mirror the
 jnp correctness oracles, and ``bench_sampler.py --pallas`` /
-``bench_feature.py --pallas`` stay wired in ``chip_suite4.sh``), so
+``bench_feature.py --pallas`` stay wired in ``chip_suite.sh``), so
 the moment hardware returns the decision can be revisited with
 numbers. They are NOT on any production call path.
 """

@@ -30,7 +30,8 @@ import jax
 from .._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..comm import dist_lookup_local
+from ..comm import default_exchange_cap, dist_lookup_local
+from ..pyg.sage_sampler import layer_shapes
 from .train import (TrainState, _check_donatable, _check_rows,
                     _fused_loss, _pmean_update, cross_entropy_logits,
                     _DONATED_DOC)
@@ -45,7 +46,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                           indices_stride: int | None = None,
                           with_replicate: bool = False,
                           hub_frac: float | None = None,
-                          donate: bool = True):
+                          donate: bool = True,
+                          exchange_cap=None):
     """fn(state, spmd_feat, g2h, g2l, indptr, indices, seeds, labels,
     key[, indices_rows][, is_rep, rep_rank, bases]) -> (state, loss).
 
@@ -64,9 +66,29 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
     replicated-node operands (``DistFeature._rep_args``) so replicated
     nodes resolve against the calling host's replica tail instead of
     being mis-routed to their owner with a tail-local index.
+
+    ``exchange_cap`` (``True | int | None``) switches the feature
+    exchange to the COMPACT deduplicated collective
+    (``comm.dist_lookup_local``): the frontier's valid ids dedup once,
+    bucket by owner into a static [H, cap] request block, and the wire
+    carries [H, cap] / [H, cap, width] instead of the dense
+    [H, B] / [H, B, width] — B being the full multi-hop frontier cap,
+    mostly -1 padding plus repeated hubs, so this is the step that
+    makes the multi-host path bandwidth-optimal. ``True`` sizes ``cap``
+    from the frontier cap and host count
+    (``comm.default_exchange_cap``); an int pins it — prefer
+    ``PartitionInfo.plan_exchange_cap(...).cap``, which sizes from the
+    partition's degree mass. Overflowing batches (unique count or any
+    per-owner bucket) fall back to the dense path via a shard-uniform
+    ``lax.cond`` — loss-identical in every case.
     """
     sizes = list(sizes)
     h_count = mesh.shape[axis]
+    if exchange_cap is True:
+        frontier = layer_shapes(per_host_batch, sizes)[-1].n_id_cap
+        exchange_cap = default_exchange_cap(frontier, h_count)
+    elif exchange_cap is not None:
+        exchange_cap = int(exchange_cap)
 
     def make_per_shard(has_rows):
         # shard_map arity is fixed at build time; ``has_rows`` says
@@ -86,7 +108,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                 # QuantizedTensor has no .dtype to pass anyway
                 return dist_lookup_local(n_id, g2h, g2l, feat_, axis,
                                          h_count, rows_per_host,
-                                         rep=rep or None)
+                                         rep=rep or None,
+                                         exchange_cap=exchange_cap)
 
             loss, grads = jax.value_and_grad(
                 lambda p: _fused_loss(model, loss_fn, sizes, per_host_batch,

@@ -19,6 +19,13 @@ gather as extra operands, so this phase pins that they leak neither
 executables (the sidecar shapes are as static as the data's) nor live
 buffers across 50 batches.
 
+Phase 4 drives 50 pipelined COMPACT-EXCHANGE dist lookups (the
+``exchange_cap`` [H, cap] collective, virtual 8-host mesh) alongside
+donated compact-exchange dist train steps, alternating duplicate-heavy
+batches (narrow branch) with unique-heavy ones (dense ``lax.cond``
+fallback): both branches live in ONE compiled program, so the
+executable cache must not grow no matter which branch a batch takes.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -28,6 +35,13 @@ import resource
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# phase 4 needs the virtual 8-host mesh (same setup as tests/conftest.py);
+# set before jax import
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np
 
@@ -194,6 +208,129 @@ def main():
         "device buffer leak in the int8-tier loop (scale/zero sidecars?)"
     qstore.close()
     print("no leak detected (phase 3: pipelined int8-tier lookups)")
+
+    # ---- phase 4: pipelined compact-exchange dist lookups + steps ----
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from quiver_tpu.parallel import build_dist_train_step
+
+    hosts = 8
+    dn, ddim = 400, 16
+    dg2h = rng.integers(0, hosts, dn).astype(np.int32)
+    dg2h[:hosts] = np.arange(hosts)
+    ddeg = rng.integers(1, 7, dn).astype(np.int64)
+    dindptr = np.zeros(dn + 1, np.int64)
+    np.cumsum(ddeg, out=dindptr[1:])
+    dindices = rng.integers(0, dn, int(dindptr[-1]), dtype=np.int32)
+    dfeat = rng.standard_normal((dn, ddim)).astype(np.float32)
+    dlabels = rng.integers(0, 8, dn).astype(np.int32)
+
+    mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+    dinfo = qv.PartitionInfo(host=0, hosts=hosts, global2host=dg2h)
+    dcomm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh, axis="host")
+    # cap small enough that a unique-heavy batch overflows its
+    # per-shard unique table (dense fallback) while a duplicate-heavy
+    # one stays narrow — self-checked against the analytic branch
+    # mirror below, so the phase can't silently stop exercising one
+    # branch
+    cap = 8
+    ddist = qv.DistFeature.from_partition(dfeat, dinfo, dcomm,
+                                          exchange_cap=cap)
+
+    def dist_lookup(ids):
+        out = ddist[ids]
+        jax.block_until_ready(out)
+        return out
+
+    size = hosts * 96
+
+    def make_batch(i):
+        # even i: duplicate-heavy (16 distinct -> narrow branch);
+        # odd i: unique-heavy (~85 distinct per 96-id shard slice,
+        # > the min(cap*H, 96)=64 unique table -> fallback)
+        if i % 2 == 0:
+            pool = rng.integers(0, dn, 16)
+            ids = pool[rng.integers(0, pool.size, size)]
+        else:
+            ids = rng.integers(0, dn, size)
+        return ids.astype(np.int32)
+
+    def mixed_batches(count):
+        for i in range(count):
+            yield jnp.asarray(make_batch(i))
+
+    # the phase's premise, pinned analytically (one shared copy of the
+    # branch logic): every even batch fits the narrow path on every
+    # shard, every odd batch overflows on at least one shard (the
+    # pmax'd flag then sends ALL shards down the dense fallback)
+    from quiver_tpu.ops.dedup import compact_exchange_slots
+
+    def shard_fits(ids):
+        per = ids.reshape(hosts, -1)
+        return [compact_exchange_slots(s, cap, hosts, owner=dg2h)
+                == cap * hosts for s in per]
+
+    probe_rng_state = rng.bit_generator.state
+    assert all(shard_fits(make_batch(0))), "even batch must fit narrow"
+    assert not all(shard_fits(make_batch(1))), \
+        "odd batch must trip the dense fallback"
+    rng.bit_generator.state = probe_rng_state
+
+    dsizes, dbs = [3, 2], 8
+    dmodel = GraphSAGE(hidden_dim=16, out_dim=8, num_layers=2,
+                       dropout=0.0)
+    dtx = optax.adam(1e-3)
+    dindptr_j = jnp.asarray(dindptr.astype(np.int32))
+    dindices_j = jnp.asarray(dindices)
+    dn_id, dlayers = sample_multihop(dindptr_j, dindices_j,
+                                     jnp.arange(dbs, dtype=jnp.int32),
+                                     dsizes, jax.random.key(0))
+    dstate = init_state(dmodel, dtx,
+                        masked_feature_gather(jnp.asarray(dfeat), dn_id),
+                        layers_to_adjs(dlayers, dbs, dsizes),
+                        jax.random.key(1))
+    dstep = build_dist_train_step(dmodel, dtx, dsizes, dbs, mesh,
+                                  rows_per_host=ddist._rows_per_host,
+                                  exchange_cap=cap)   # donated state
+    sharding = NamedSharding(mesh, P("host"))
+    labels_j = jnp.asarray(dlabels)
+
+    def one_dist_step(state, it):
+        seeds = jax.device_put(jnp.asarray(
+            rng.integers(0, dn, hosts * dbs, dtype=np.int32)), sharding)
+        return dstep(state, ddist._spmd_feat,
+                     dinfo.global2host.astype(jnp.int32),
+                     dinfo.global2local, dindptr_j, dindices_j, seeds,
+                     labels_j[seeds], jax.random.key(it))
+
+    # warmup: compile the lookup (its one program holds BOTH cond
+    # branches) + the donated step, settle caches
+    for _ in pipelined(dist_lookup, mixed_batches(4)):
+        pass
+    dstate, _ = one_dist_step(dstate, 0)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    lookup_fns = list(ddist._lookup_fns.values())
+    base_cache = sum(f._cache_size() for f in lookup_fns)
+
+    for i, out in enumerate(pipelined(dist_lookup, mixed_batches(50))):
+        dstate, dloss = one_dist_step(dstate, 100 + i)
+    jax.block_until_ready(dloss)
+    del out
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    assert list(ddist._lookup_fns.values()) == lookup_fns, \
+        "compact dist lookup built new programs mid-loop"
+    grew = sum(f._cache_size() for f in lookup_fns) - base_cache
+    print(f"phase 4 live arrays: {base_arrays} -> {arrays}; "
+          f"compact-exchange executable-cache growth: {grew}")
+    # both lax.cond branches live in the ONE warmed executable: zero
+    # growth even though batches alternate narrow/fallback
+    assert grew == 0, \
+        "compact exchange recompiled mid-loop (branch/shape leak)"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak in the compact-exchange dist loop"
+    print("no leak detected (phase 4: pipelined compact-exchange "
+          "dist steps)")
 
 
 if __name__ == "__main__":

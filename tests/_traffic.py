@@ -81,27 +81,33 @@ def tier_read_bytes(fn, args, tier, max_depth=0):
     return total
 
 
-def collective_payloads(fn, args, prims=("all_to_all",)):
+def collective_payloads(fn, args, prims=("all_to_all",),
+                        with_depth=False):
     """Every collective equation's payload in the traced program —
     the exchange's wire traffic. Returns ``[(shape, dtype, bytes)]``
     (requests AND responses both appear; callers filter by shape/dtype
-    when they want one direction)."""
+    when they want one direction). ``with_depth=True`` appends the
+    ``lax.cond`` nesting depth as a fourth element (0 = the
+    unconditional path; the compact exchange keeps BOTH its narrow
+    collectives and the dense fallback inside one cond, so callers
+    separate them by payload shape, and use depth to assert nothing
+    dense-shaped leaked onto the unconditional path)."""
     jaxpr = jax.make_jaxpr(fn)(*args)
 
-    def walk(j):
+    def walk(j, depth):
         out = []
         for eqn in j.eqns:
             if eqn.primitive.name in prims:
                 aval = eqn.invars[0].aval
-                out.append((tuple(aval.shape),
-                            jax.numpy.dtype(aval.dtype),
-                            int(np.prod(aval.shape)) *
-                            aval.dtype.itemsize))
+                rec = (tuple(aval.shape),
+                       jax.numpy.dtype(aval.dtype),
+                       int(np.prod(aval.shape)) * aval.dtype.itemsize)
+                out.append(rec + (depth,) if with_depth else rec)
             if eqn.primitive.name == "cond":
                 for br in eqn.params["branches"]:
-                    out += walk(br.jaxpr)
+                    out += walk(br.jaxpr, depth + 1)
             for sub in _sub_jaxprs(eqn):
-                out += walk(sub)
+                out += walk(sub, depth)
         return out
 
-    return walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr, 0)

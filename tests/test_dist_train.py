@@ -183,6 +183,136 @@ class TestDistTrainStep:
         np.testing.assert_allclose(float(d_loss), float(dp_loss),
                                    rtol=1e-5)
 
+    def test_compact_exchange_loss_parity_exact(self, setup, rng):
+        """The tentpole contract: the compact deduplicated exchange is
+        BIT-IDENTICAL to the dense [H, B] path — on the narrow branch
+        (roomy cap) and through the lax.cond fallback (cap too small
+        for the frontier's unique count)."""
+        (mesh, info, dist, model, tx, sizes, per_host, indptr, indices,
+         feat, labels, state, hosts) = setup
+        g = hosts * per_host
+        seeds = jnp.asarray(
+            rng.choice(240, g, replace=False).astype(np.int32))
+        y = labels[seeds]
+        key = jax.random.key(7)
+        sharding = NamedSharding(mesh, P("host"))
+        seeds_s = jax.device_put(seeds, sharding)
+        y_s = jax.device_put(y, sharding)
+
+        def run(exchange_cap):
+            step = build_dist_train_step(
+                model, tx, sizes, per_host, mesh,
+                rows_per_host=dist._rows_per_host, donate=False,
+                exchange_cap=exchange_cap)
+            st, loss = step(
+                state, dist._spmd_feat,
+                info.global2host.astype(jnp.int32), info.global2local,
+                indptr, indices, seeds_s, y_s, key)
+            return np.asarray(loss), st
+
+        dense_loss, dense_state = run(None)
+        # roomy cap (narrow branch), starvation cap (dense fallback),
+        # and the self-sizing True knob — all bit-identical
+        for cap in (16, 1, True):
+            c_loss, c_state = run(cap)
+            np.testing.assert_array_equal(c_loss, dense_loss)
+            a = np.asarray(dense_state.params["params"]["conv0"]
+                           ["lin_nbr"]["kernel"])
+            b = np.asarray(c_state.params["params"]["conv0"]
+                           ["lin_nbr"]["kernel"])
+            np.testing.assert_array_equal(b, a)
+
+    def test_compact_exchange_quantized_store_parity(self, setup, rng):
+        """exchange_cap composes with dtype_policy: the narrow int8
+        payload + sidecars ride the COMPACT collectives and the loss
+        still matches the dense path bit-for-bit (dequant is
+        elementwise, so expand-after-dequant == dequant-after-expand)."""
+        (mesh, info, _, model, tx, sizes, per_host, indptr, indices,
+         feat, labels, state, hosts) = setup
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        dist8 = qv.DistFeature.from_partition(
+            np.asarray(feat), info, comm, dtype_policy="int8")
+        g = hosts * per_host
+        seeds = jnp.asarray(
+            rng.choice(240, g, replace=False).astype(np.int32))
+        y = labels[seeds]
+        key = jax.random.key(9)
+        sharding = NamedSharding(mesh, P("host"))
+        seeds_s = jax.device_put(seeds, sharding)
+        y_s = jax.device_put(y, sharding)
+
+        def run(exchange_cap):
+            step = build_dist_train_step(
+                model, tx, sizes, per_host, mesh,
+                rows_per_host=dist8._rows_per_host, donate=False,
+                exchange_cap=exchange_cap)
+            _, loss = step(
+                state, dist8._spmd_feat,
+                info.global2host.astype(jnp.int32), info.global2local,
+                indptr, indices, seeds_s, y_s, key)
+            return np.asarray(loss)
+
+        np.testing.assert_array_equal(run(16), run(None))
+
+    def test_compact_exchange_with_replicate_parity(self, rng):
+        """exchange_cap composes with replicated-node resolution: the
+        rep override rewrites owners per shard BEFORE the unique-table
+        bucketing, so replicated hubs still resolve locally."""
+        n, dim, classes, hosts = 160, 8, 4, 8
+        deg = rng.integers(1, 7, n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        labels = rng.integers(0, classes, n).astype(np.int32)
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        rep = np.array([3, 77, 140], np.int32)
+
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h,
+                                replicate=rep)
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        dist = qv.DistFeature.from_partition(feat, info, comm)
+
+        sizes, per_host = [3, 2], 6
+        model = GraphSAGE(hidden_dim=16, out_dim=classes, num_layers=2,
+                          dropout=0.0)
+        tx = optax.adam(1e-2)
+        indptr_j = jnp.asarray(indptr.astype(np.int32))
+        indices_j = jnp.asarray(indices)
+        n_id, layers = sample_multihop(
+            indptr_j, indices_j, jnp.arange(per_host, dtype=jnp.int32),
+            sizes, jax.random.key(0))
+        state = init_state(model, tx,
+                           masked_feature_gather(jnp.asarray(feat), n_id),
+                           layers_to_adjs(layers, per_host, sizes),
+                           jax.random.key(1))
+
+        g = hosts * per_host
+        seeds = np.tile(rep, g // 3 + 1)[:g].astype(np.int32)
+        seeds[1::2] = rng.choice(n, g // 2, replace=False)
+        sharding = NamedSharding(mesh, P("host"))
+        seeds_s = jax.device_put(jnp.asarray(seeds), sharding)
+        y_s = jax.device_put(jnp.asarray(labels[seeds]), sharding)
+        key = jax.random.key(33)
+
+        def run(exchange_cap):
+            step = build_dist_train_step(
+                model, tx, sizes, per_host, mesh,
+                rows_per_host=dist._rows_per_host, with_replicate=True,
+                donate=False, exchange_cap=exchange_cap)
+            _, loss = step(
+                state, dist._spmd_feat,
+                info.global2host.astype(jnp.int32), info.global2local,
+                indptr_j, indices_j, seeds_s, y_s, key,
+                rep_args=dist._rep_args)
+            return np.asarray(loss)
+
+        np.testing.assert_array_equal(run(12), run(None))
+
     def test_trains(self, setup, rng):
         (mesh, info, dist, model, tx, sizes, per_host, indptr, indices,
          feat, labels, state, hosts) = setup
@@ -203,3 +333,153 @@ class TestDistTrainStep:
                 jax.random.fold_in(jax.random.key(5), it))
             losses.append(float(loss))
         assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+class TestCompactExchangeTrafficPin:
+    """Static wire-byte pins for the FUSED dist step's exchange, on the
+    traced program (no compile/run — bench fanouts trace in well under
+    a second): the compact [H, cap] collectives must carry <= 1/4 the
+    payload bytes of the dense [H, B] path at bench shapes, and the
+    dense shapes must never appear on the unconditional path of the
+    compact program (they live only in the lax.cond fallback)."""
+
+    def _trace_args(self, rng, per_host, hosts=8, n=1200, dim=16):
+        deg = rng.integers(1, 9, n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        labels = rng.integers(0, 4, n).astype(np.int32)
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        dist = qv.DistFeature.from_partition(feat, info, comm)
+        g = hosts * per_host
+        seeds = jnp.asarray(rng.choice(n, g, replace=False)
+                            .astype(np.int32))
+        y = jnp.asarray(labels)[seeds]
+        return (mesh, info, dist,
+                (dist._spmd_feat, info.global2host.astype(jnp.int32),
+                 info.global2local, jnp.asarray(indptr.astype(np.int32)),
+                 jnp.asarray(indices), seeds, y, jax.random.key(0)))
+
+    def test_bench_fanout_payload_bytes_quarter_of_dense(self, rng):
+        from _traffic import collective_payloads
+        from quiver_tpu.pyg.sage_sampler import layer_shapes
+        import optax as _optax
+        from quiver_tpu.models import GraphSAGE as _Sage
+
+        hosts, per_host, sizes = 8, 8, [15, 10, 5]   # bench fanouts
+        frontier = layer_shapes(per_host, sizes)[-1].n_id_cap
+        mesh, info, dist, args = self._trace_args(rng, per_host)
+        model = _Sage(hidden_dim=8, out_dim=4, num_layers=3,
+                      dropout=0.0)
+        tx = _optax.adam(1e-2)
+        n_id, layers = sample_multihop(
+            args[3], args[4], jnp.arange(per_host, dtype=jnp.int32),
+            sizes, jax.random.key(0))
+        state = init_state(
+            model, tx,
+            masked_feature_gather(jnp.asarray(np.zeros((1200, 16),
+                                                       np.float32)),
+                                  n_id),
+            layers_to_adjs(layers, per_host, sizes), jax.random.key(1))
+        cap = qv.comm.default_exchange_cap(frontier, hosts)
+        assert cap * 4 <= frontier            # the sizing itself
+
+        def build(exchange_cap):
+            return build_dist_train_step(
+                model, tx, sizes, per_host, mesh,
+                rows_per_host=dist._rows_per_host, donate=False,
+                exchange_cap=exchange_cap)
+
+        dense = collective_payloads(build(None), (state,) + args,
+                                    with_depth=True)
+        compact = collective_payloads(build(cap), (state,) + args,
+                                      with_depth=True)
+        # dense program: the [H, B] pair on the unconditional path
+        dense_bytes = sum(b for s, _, b, d in dense)
+        assert dense_bytes
+        assert {s[1] for s, _, b, d in dense} == {frontier}
+        assert all(d == 0 for *_x, d in dense)
+        # compact program: narrow [H, cap] collectives; the dense
+        # shapes survive ONLY inside the cond fallback, and nothing
+        # rides the unconditional path
+        narrow_bytes = sum(b for s, _, b, d in compact if s[1] == cap)
+        fallback = [(s, d) for s, _, b, d in compact if s[1] == frontier]
+        assert narrow_bytes and fallback
+        assert all(d >= 1 for _, d in fallback)
+        assert all(d >= 1 for *_x, d in compact)
+        # the acceptance pin: <= 1/4 of the dense wire bytes (actual
+        # ratio at these shapes is ~frontier/cap ~ 40x)
+        assert narrow_bytes * 4 <= dense_bytes, (narrow_bytes,
+                                                 dense_bytes)
+
+    def test_compact_branch_conditions_analytic_mirror(self):
+        """ops.dedup.compact_exchange_slots is the ONE analytic copy of
+        the branch logic the benches report from — pin its conditions:
+        duplicate-heavy fits (cap*hosts slots), unique-table overflow
+        and per-owner bucket overflow fall back to the full batch."""
+        from quiver_tpu.ops.dedup import compact_exchange_slots
+        hosts, cap = 8, 4
+        dup_heavy = np.tile(np.arange(16, dtype=np.int32), 64)  # 16 uniq
+        assert compact_exchange_slots(dup_heavy, cap, hosts) == cap * hosts
+        # unique count 64 > cap*hosts=32 -> dense
+        wide = np.arange(64, dtype=np.int32).repeat(16)
+        assert compact_exchange_slots(wide, cap, hosts) == wide.size
+        # 8 uniq ids all owned by host 0 (> cap=4) -> dense
+        skew = np.tile(np.arange(8, dtype=np.int32) * hosts, 128)
+        assert compact_exchange_slots(skew, cap, hosts) == skew.size
+        # -1 padding doesn't count against the table
+        padded = np.full(1024, -1, np.int32)
+        padded[:16] = np.arange(16)
+        assert compact_exchange_slots(padded, cap, hosts) == cap * hosts
+        # cap >= batch: compact can't beat the dense block
+        assert compact_exchange_slots(dup_heavy[:8], 8, hosts) == 8
+
+    def test_plan_exchange_cap_degree_mass(self, rng):
+        """The sizing helper: a host owning the degree mass gets the
+        bigger bucket; the plan respects the frontier ceiling."""
+        n, hosts = 400, 8
+        g2h = (np.arange(n) % hosts).astype(np.int32)
+        deg = np.ones(n)
+        deg[g2h == 3] = 50.0          # host 3 owns the mass
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+        plan = info.plan_exchange_cap(4096, degree=deg)
+        balanced = info.plan_exchange_cap(4096)
+        assert plan.cap > balanced.cap
+        assert plan.owner_frac > 0.8
+        assert plan.unique_budget == plan.cap * hosts
+        assert info.plan_exchange_cap(16).cap <= 16
+        # and the partition-blind default stays within its pin
+        assert qv.comm.default_exchange_cap(4096, hosts) * 4 <= 4096
+
+    def test_distfeature_getitem_compact_parity(self, rng):
+        """DistFeature.__getitem__ with exchange_cap: bit-identical to
+        the dense store, -1 fill included, and composing with
+        dedup_cold."""
+        n, dim, hosts = 96, 8, 8
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        mesh = Mesh(np.array(jax.devices()), axis_names=("host",))
+        info = qv.PartitionInfo(host=0, hosts=hosts, global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=hosts, mesh=mesh,
+                          axis="host")
+        dense = qv.DistFeature.from_partition(feat, info, comm)
+        compact = qv.DistFeature.from_partition(feat, info, comm,
+                                                exchange_cap=8)
+        both = qv.DistFeature.from_partition(feat, info, comm,
+                                             dedup_cold=True,
+                                             exchange_cap=8)
+        pool = rng.integers(0, n, 12)
+        ids = pool[rng.integers(0, 12, hosts * 32)].astype(np.int32)
+        ids[::7] = -1
+        want = np.asarray(dense[jnp.asarray(ids)])
+        np.testing.assert_array_equal(
+            np.asarray(compact[jnp.asarray(ids)]), want)
+        np.testing.assert_array_equal(
+            np.asarray(both[jnp.asarray(ids)]), want)
