@@ -418,6 +418,21 @@ def main():
                                      store.feature_order)
         jax.block_until_ready(r)
         rps = f_batch * len(batches_f) / (time.perf_counter() - t0)
+        # ---- OBSERVED device counters over the same batches (untimed
+        # pass): the telemetry the analytic mirrors below only predict —
+        # actual hot-tier hit rate and frontier dup factor out of the
+        # fused lookup's own classification masks (quiver_tpu.metrics)
+        from quiver_tpu import metrics as qmetrics
+        total_c = None
+        for a in batches_f:
+            _, c = store._lookup_tiered(store.device_part, host, a,
+                                        store.feature_order, False, True)
+            total_c = c if total_c is None else \
+                qmetrics.merge_counters(total_c, c)
+        observed = qmetrics.derive(total_c)
+        counts = qmetrics.reduce_counters(total_c)
+        observed_cold_rows = (counts[qmetrics.COLD_ROWS]
+                              / len(batches_f))
         # ---- bytes/batch, the currency feature collection is paid in
         # (host tier + what a cross-host exchange of this batch ships).
         # Analytic, via the ONE shared mirror of lookup_tiered's branch
@@ -449,11 +464,11 @@ def main():
             compact_exchange_slots(a, cap, exch_hosts) * (4 + row_b)
             for a in batches_f) / len(batches_f)
         return (rps, host_bytes / len(batches_f), exch_bytes, cap,
-                compact_bytes)
+                compact_bytes, observed, observed_cold_rows)
 
     (feature_gather_rps, host_bytes_per_batch, exchange_bytes_per_batch,
-     exchange_cap, exchange_compact_bytes_per_batch) = \
-        measure_feature_gather()
+     exchange_cap, exchange_compact_bytes_per_batch, observed,
+     observed_cold_rows) = measure_feature_gather()
     out = {
         "metric": METRIC,
         "value": round(seps, 1),
@@ -485,6 +500,17 @@ def main():
         "exchange_cap": exchange_cap,
         "exchange_compact_bytes_per_batch":
             round(exchange_compact_bytes_per_batch, 1),
+        # OBSERVED device counters (quiver_tpu.metrics) over the same
+        # feature-gather batches — the runtime truth next to the
+        # analytic mirrors above: the hot tier's actual hit rate (what
+        # plan_hot_capacity predicted), the actual frontier dup factor
+        # (what dedup_cold's >1.3 payoff threshold assumes), and the
+        # cold rows a batch really classified
+        "observed_hot_hit_rate": round(observed["hot_hit_rate"], 4)
+            if observed["hot_hit_rate"] is not None else None,
+        "observed_dup_factor": round(observed["dup_factor"], 3)
+            if observed["dup_factor"] is not None else None,
+        "observed_cold_rows_per_batch": round(observed_cold_rows, 1),
     }
     # every measured rotation config, for the record (always present so
     # log consumers never hit a missing key)
@@ -500,6 +526,16 @@ def main():
         out["window_mode_vs_baseline"] = None
     _bench_done.set()
     print(json.dumps(out), flush=True)
+    # optional structured emission: the same record, through the one
+    # JSONL schema the watch scripts tail (QT_METRICS_JSONL=path)
+    sink_path = os.environ.get("QT_METRICS_JSONL")
+    if sink_path:
+        try:
+            from quiver_tpu.metrics import MetricsSink
+            with MetricsSink(sink_path) as sink:
+                sink.emit(out, kind="bench")
+        except Exception as e:          # telemetry must never fail a run
+            print(f"metrics sink failed: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ platform "up". Run this before committing to a long suite.
 """
 
 import json
+import os
 import signal
 import sys
 import time
@@ -19,9 +20,41 @@ STAGES = {}
 _t0 = time.perf_counter()
 
 
+def _emit(rec, inline_only=False):
+    """Mirror the result into the structured metrics log
+    (QT_METRICS_JSONL) with the MetricsSink record schema
+    ({"ts", "kind": "canary", ...}) so the chip watcher's history is
+    machine-readable alongside its text log. Best-effort: the canary's
+    stdout contract must survive a broken quiver_tpu import (inline
+    fallback) and a broken path (swallowed). ``inline_only`` skips the
+    MetricsSink import entirely — from the SIGALRM handler, importing
+    quiver_tpu can re-enter the very ``import jax`` that hung and
+    deadlock on the interpreter's import lock."""
+    path = os.environ.get("QT_METRICS_JSONL")
+    if not path:
+        return
+    if not inline_only:
+        try:
+            from quiver_tpu.metrics import MetricsSink
+            with MetricsSink(path) as s:
+                s.emit(rec, kind="canary")
+            return
+        except Exception:
+            pass
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({"ts": round(time.time(), 3),
+                                "kind": "canary", **rec}) + "\n")
+    except Exception:
+        pass
+
+
 def _die(signum, frame):
-    print(json.dumps({"usable": False, "stages": STAGES,
-                      "error": "alarm: stage hung"}), flush=True)
+    rec = {"usable": False, "stages": STAGES, "error": "alarm: stage hung"}
+    # stdout verdict FIRST: the one alarm is already consumed, so
+    # nothing may stall ahead of the hang report the canary exists for
+    print(json.dumps(rec), flush=True)
+    _emit(rec, inline_only=True)
     sys.exit(3)
 
 
@@ -40,8 +73,9 @@ try:
     backend = jax.default_backend()
     stage("backend_init")
     if backend == "cpu":
-        print(json.dumps({"usable": False, "stages": STAGES,
-                          "error": "cpu fallback"}), flush=True)
+        rec = {"usable": False, "stages": STAGES, "error": "cpu fallback"}
+        _emit(rec)
+        print(json.dumps(rec), flush=True)
         sys.exit(2)
     x = jax.device_put(np.arange(1024, dtype=np.float32))
     x.block_until_ready()
@@ -60,11 +94,13 @@ try:
     bw = 16.0 / max(time.perf_counter() - t, 1e-9)
     stage("h2d_16mb")
     signal.alarm(0)
-    print(json.dumps({"usable": True, "backend": backend,
-                      "stages": STAGES,
-                      "h2d_MBps": round(bw, 1)}), flush=True)
+    rec = {"usable": True, "backend": backend, "stages": STAGES,
+           "h2d_MBps": round(bw, 1)}
+    _emit(rec)
+    print(json.dumps(rec), flush=True)
 except Exception as e:  # noqa: BLE001 - report any failure as unusable
     signal.alarm(0)
-    print(json.dumps({"usable": False, "stages": STAGES,
-                      "error": repr(e)[:300]}), flush=True)
+    rec = {"usable": False, "stages": STAGES, "error": repr(e)[:300]}
+    _emit(rec)
+    print(json.dumps(rec), flush=True)
     sys.exit(1)

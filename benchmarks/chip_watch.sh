@@ -11,6 +11,11 @@
 # Usage: sh benchmarks/chip_watch.sh [MAX_PROBES] [PROBE_SLEEP] [suite...]
 #   defaults: 200 probes, 120 s apart, suites = chip_suite.sh
 # Env: PROBE_CMD overrides the probe (tests stub it with `true`).
+#      QT_METRICS_JSONL (default benchmarks/metrics.jsonl) collects the
+#      canary's structured records ({"ts","kind":"canary",...} — the
+#      quiver_tpu.metrics.MetricsSink schema) and any bench records the
+#      suites emit, so the watch history is machine-readable alongside
+#      this script's text log.
 #
 # Prefer benchmarks/arm_watch.sh for the full unattended
 # recover -> run -> transcribe -> commit pipeline; this script is the
@@ -22,6 +27,8 @@ PROBE_SLEEP=${2:-120}
 [ $# -ge 2 ] && shift 2 || shift $#
 SUITES=${*:-"benchmarks/chip_suite.sh"}
 PROBE_CMD=${PROBE_CMD:-"timeout 300 python benchmarks/canary.py 150"}
+QT_METRICS_JSONL=${QT_METRICS_JSONL:-benchmarks/metrics.jsonl}
+export QT_METRICS_JSONL
 
 echo "$(date) watcher start: max=$MAX_PROBES sleep=${PROBE_SLEEP}s suites=[$SUITES]" >> "$LOG"
 i=0

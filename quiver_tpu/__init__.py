@@ -43,7 +43,8 @@ from .debug import show_tensor_info
 from .inference import layerwise_inference
 from .datasets import GraphDataset, from_numpy_dir
 from .pipeline import Pipeline, pipelined
-from . import comm, profiling, checkpoint, datasets, debug
+from .metrics import Collector, MetricsSink, StepStats
+from . import comm, profiling, checkpoint, datasets, debug, metrics
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -91,4 +92,7 @@ __all__ = [
     "layerwise_inference",
     "Pipeline",
     "pipelined",
+    "Collector",
+    "MetricsSink",
+    "StepStats",
 ]

@@ -148,7 +148,8 @@ def default_exchange_cap(batch: int, hosts: int, slack: float = 1.25) -> int:
 def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
                       feat, axis: str, h_count: int,
                       rows_per_host: int, dtype=None, rep=None,
-                      exchange_cap: Optional[int] = None):
+                      exchange_cap: Optional[int] = None,
+                      collector=None):
     """The per-shard body of the fused DistFeature lookup — callable from
     INSIDE any ``shard_map`` over ``axis`` (e.g. the multi-host fused
     train step composes it with sampling and the model step):
@@ -188,6 +189,14 @@ def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
     expand-after-dequant equals dequant-after-expand). The overflow
     flag is ``pmax``-reduced over ``axis`` first: the branch must be
     UNIFORM across shards or the collectives inside it would deadlock.
+
+    ``collector`` (optional ``metrics.Collector``) records the branch
+    telemetry the cap planner flies blind on: whether the dense
+    fallback fired, the peak per-owner bucket load vs ``cap``, and the
+    dedup dup statistics — all from values this function already
+    computes OUTSIDE the ``lax.cond`` (the shard-uniform pmax'd flag
+    included), so collection adds no host sync and cannot perturb the
+    branch decision or the output.
     """
     batch = ids.shape[0]
     valid = ids >= 0
@@ -231,40 +240,67 @@ def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
     def exchange(req, owner, my_pos):
         """The collective pair: requests out, local gather, responses
         back, unbucket to the caller's slot order ([n, dim])."""
-        incoming = jax.lax.all_to_all(
-            req, axis, split_axis=0, concat_axis=0)
-        read = jnp.clip(incoming, 0, rows_per_host - 1)
+        with jax.named_scope("qt_exchange_requests"):
+            incoming = jax.lax.all_to_all(
+                req, axis, split_axis=0, concat_axis=0)
+            read = jnp.clip(incoming, 0, rows_per_host - 1)
 
         def ship(leaf):
-            rows = leaf[read]
-            resp = jax.lax.all_to_all(
-                rows, axis, split_axis=0, concat_axis=0)
+            with jax.named_scope("qt_exchange_gather"):
+                rows = leaf[read]
+            with jax.named_scope("qt_exchange_responses"):
+                resp = jax.lax.all_to_all(
+                    rows, axis, split_axis=0, concat_axis=0)
             return resp[jnp.clip(owner, 0), my_pos]
 
         # narrow payload + sidecars cross the collective; dequant
         # happens on the unbucketed result, after the exchange
         return quant.dequantize(quant.tree_map_tier(ship, feat))
 
-    owner, local = route(ids, valid)
+    with jax.named_scope("qt_exchange_route"):
+        owner, local = route(ids, valid)
+    if collector is not None:
+        from .metrics import EXCH_CALLS
+        collector.add(EXCH_CALLS, 1)
+
+    def dense_bucket():
+        with jax.named_scope("qt_exchange_bucket"):
+            return bucket(owner, local, valid, batch)
 
     def dense(_=None):
-        req, my_pos, _counts = bucket(owner, local, valid, batch)
+        # the lax.cond fallback body: must NOT touch the collector —
+        # entries recorded inside a cond branch would leak its tracers
+        req, my_pos, _counts = dense_bucket()
         return exchange(req, owner, my_pos)
 
     if exchange_cap is None or int(exchange_cap) >= batch:
-        out = dense()
+        req, my_pos, counts = dense_bucket()
+        if collector is not None:
+            from .metrics import EXCH_BUCKET_MAX
+            collector.peak(EXCH_BUCKET_MAX, jnp.max(counts))
+        out = exchange(req, owner, my_pos)
     else:
         cap = int(exchange_cap)
         u_budget = min(cap * h_count, batch)
         uniq, inv, n_uniq = unique_within_budget(ids, u_budget,
-                                                 valid=valid)
+                                                 valid=valid,
+                                                 collector=collector)
         u_valid = uniq != I32_MAX
-        owner_u, local_u = route(uniq, u_valid)
-        req_u, my_pos_u, counts = bucket(owner_u, local_u, u_valid, cap)
+        with jax.named_scope("qt_exchange_bucket"):
+            owner_u, local_u = route(uniq, u_valid)
+            req_u, my_pos_u, counts = bucket(owner_u, local_u, u_valid,
+                                             cap)
         bad = (n_uniq > u_budget) | (jnp.max(counts) > cap)
         # the branch carries collectives: every shard must take the
         # same one, so one scalar pmax unifies the overflow flag
         bad = jax.lax.pmax(bad.astype(jnp.int32), axis) > 0
+        if collector is not None:
+            # recorded OUTSIDE the cond, on the already-pmax'd flag —
+            # the predicate itself is untouched
+            from .metrics import EXCH_BUCKET_MAX, EXCH_CAP, EXCH_FALLBACK
+            collector.add(EXCH_FALLBACK, bad)
+            collector.peak(EXCH_BUCKET_MAX, jnp.max(counts))
+            collector.peak(EXCH_CAP, cap)
 
         def compact(_):
             rows_u = exchange(req_u, owner_u,
@@ -281,7 +317,8 @@ def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
 def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
                          batch_per_host: int, dtype=None,
                          with_replicate: bool = False,
-                         exchange_cap: Optional[int] = None):
+                         exchange_cap: Optional[int] = None,
+                         collect_metrics: bool = False):
     """The WHOLE DistFeature lookup as one jitted SPMD program
     (reference feature.py:555-567 dispatch + comm.py:127-182 exchange +
     scatter, fused):
@@ -309,14 +346,27 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
 
     ``exchange_cap`` (None = dense) switches the exchange to the
     compact deduplicated [H, cap] layout — see ``dist_lookup_local``.
+
+    ``collect_metrics=True`` adds a second output: the per-shard
+    ``[H, metrics.NUM_COUNTERS]`` int32 device counter block (fallback
+    flag, peak bucket load vs cap, dedup statistics) — pure jnp
+    accumulation, no host sync, rows bit-identical either way.
     """
     h_count = mesh.shape[axis]
 
     def body(ids, g2h, loc, feat, *rep):
-        return dist_lookup_local(ids.reshape(-1), g2h, loc, feat, axis,
-                                 h_count, rows_per_host, dtype,
-                                 rep=rep or None,
-                                 exchange_cap=exchange_cap)
+        col = None
+        if collect_metrics:
+            from .metrics import Collector
+            col = Collector()
+        out = dist_lookup_local(ids.reshape(-1), g2h, loc, feat, axis,
+                                h_count, rows_per_host, dtype,
+                                rep=rep or None,
+                                exchange_cap=exchange_cap,
+                                collector=col)
+        if collect_metrics:
+            return out, col.counters()[None]
+        return out
 
     specs = (P(axis), P(), P(), P(axis))
     if with_replicate:
@@ -324,7 +374,7 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=specs,
-        out_specs=P(axis),
+        out_specs=(P(axis), P(axis)) if collect_metrics else P(axis),
         check_vma=False)
     return jax.jit(mapped)
 

@@ -4,21 +4,57 @@
 (tensor.cpp:25-96); ``log`` replaces the scattered ``print("LOG>>>")``
 calls (feature.py:208-210, shard_tensor.py:90-135) with a stdlib logger
 users can silence or redirect.
+
+Logger policy (library-friendly):
+
+- the handler is attached ONCE, marked, and only when the logger has
+  no handlers at all — a re-import under another module name or a
+  forked multiprocessing worker re-running this module cannot
+  double-log, and an application that installed its own handler first
+  keeps sole ownership of the output;
+- the level comes from the ``QT_LOG_LEVEL`` env var (a name like
+  ``DEBUG``/``INFO``/``WARNING`` or a numeric level); without it the
+  logger stays at ``NOTSET`` and defers to the application's logging
+  config (effective WARNING under the stdlib default) — importing the
+  library no longer forces INFO onto every process.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 
 import jax
 import numpy as np
 
 logger = logging.getLogger("quiver_tpu")
-if not logger.handlers:
-    _h = logging.StreamHandler()
-    _h.setFormatter(logging.Formatter("[quiver_tpu] %(message)s"))
-    logger.addHandler(_h)
-    logger.setLevel(logging.INFO)
+
+_HANDLER_MARK = "_quiver_tpu_handler"
+
+
+def _configure(force: bool = False) -> None:
+    """Attach the marked handler (once) and apply ``QT_LOG_LEVEL``.
+    Idempotent — safe on re-import and in forked workers; ``force``
+    re-reads the env var (tests)."""
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter("[quiver_tpu] %(message)s"))
+        setattr(h, _HANDLER_MARK, True)
+        logger.addHandler(h)
+    level = os.environ.get("QT_LOG_LEVEL", "")
+    if not level:
+        if force:
+            logger.setLevel(logging.NOTSET)
+        return
+    try:
+        logger.setLevel(int(level) if level.isdigit() else level.upper())
+    except ValueError:
+        # a bad env value must not crash library import — say so once
+        # (at WARNING, which passes the NOTSET default) and move on
+        logger.warning("ignoring invalid QT_LOG_LEVEL=%r", level)
+
+
+_configure()
 
 
 def log(msg: str, *args):

@@ -72,6 +72,18 @@ def _resolve_tier_policy(policy) -> dict:
     raise ValueError(f"cannot parse dtype_policy {policy!r}")
 
 
+def _resolve_cold_budget(dedup_cold, cold_budget, n: int) -> int:
+    """The cold-compaction budget in force for an ``n``-slot lookup —
+    the ONE resolution both the fused gather and the numpy-path metric
+    mirror use (an explicit ``dedup_cold=int`` wins, then
+    ``cold_budget``, then the batch-sized default)."""
+    if dedup_cold and not isinstance(dedup_cold, bool):
+        return int(dedup_cold)
+    if cold_budget is not None:
+        return cold_budget
+    return quant.default_cold_budget(n)
+
+
 def _default_mesh(device_list: Optional[Sequence[int]] = None) -> Mesh:
     devs = jax.devices()
     if device_list:
@@ -139,6 +151,7 @@ class Feature:
         # (quant.plan_hot_capacity logs the expected hit-rate gain).
         self.dtype_policy = _resolve_tier_policy(dtype_policy)
         self.feature_order = None      # old id -> storage row
+        self._order_np = None          # (src, host copy) metrics cache
         self.cache_rows = 0
         self.device_part = None        # jnp [cache_rows, dim]
         self.host_part = None          # np  [rest, dim]
@@ -397,7 +410,8 @@ class Feature:
                         if dedup and not isinstance(self.dedup_cold, bool)
                         else None)
 
-        def lookup_tiered(dev_part, host_part, ids, order, masked=False):
+        def lookup_tiered_body(dev_part, host_part, ids, order,
+                               masked=False, collector=None):
             # one dispatch for the WHOLE tiered lookup: hot rows from
             # the HBM cache, cold rows gathered by XLA directly from
             # the (pinned host) cold tier — no Python round trip, no
@@ -426,10 +440,17 @@ class Feature:
             out_dt = jnp.result_type(*[
                 quant.tier_dtype(p) for p in (dev_part, host_part)
                 if p is not None])
-            take_host = lambda hids: quant.gather_rows(
-                host_part, hids).astype(out_dt)
-            take_hot = lambda hids: gather_cached(
-                dev_part, hids).astype(out_dt)
+
+            def take_host(hids):
+                # named scope: XProf attributes cold-tier (pinned host)
+                # gather time to this stage, not one opaque jit blob
+                with jax.named_scope("qt_lookup_cold"):
+                    return quant.gather_rows(host_part,
+                                             hids).astype(out_dt)
+
+            def take_hot(hids):
+                with jax.named_scope("qt_lookup_hot"):
+                    return gather_cached(dev_part, hids).astype(out_dt)
 
             def finish(rows):
                 if not masked:
@@ -445,18 +466,32 @@ class Feature:
                 # otherwise trip the full-gather fallback every batch)
                 hot = hot | (ids_raw < 0)
             n = t.shape[0]
+            if collector is not None:
+                # the OBSERVED hit rate plan_hot_capacity predicted:
+                # counted on the classification mask the lookup already
+                # computed (padding excluded), pure jnp, no host sync
+                from .metrics import COLD_ROWS, HOT_ROWS, LOOKUP_CALLS
+                collector.add(LOOKUP_CALLS, 1)
+                if masked:
+                    vmask = ids_raw >= 0
+                    hot_valid = jnp.sum(hot & vmask)
+                    n_valid = jnp.sum(vmask)
+                else:
+                    hot_valid = jnp.sum(hot)
+                    n_valid = n
+                collector.add(HOT_ROWS, hot_valid)
+                collector.add(COLD_ROWS, n_valid - hot_valid)
             cold_total = quant.tier_rows(host_part)
             cold_idx = jnp.clip(t - cache_rows, 0, max(cold_total - 1, 0))
-            budget = (dedup_budget if dedup_budget is not None
-                      else cold_budget if cold_budget is not None
-                      else quant.default_cold_budget(n))
+            budget = _resolve_cold_budget(dedup_budget, cold_budget, n)
             if dev_part is None:
                 if dedup and budget < n:
                     # no HBM cache: every slot is cold — dedup still
                     # bounds the host read to unique rows
                     from .ops.dedup import dedup_take
-                    return finish(dedup_take(host_part, cold_idx,
-                                             budget).astype(out_dt))
+                    return finish(dedup_take(
+                        host_part, cold_idx, budget,
+                        collector=collector).astype(out_dt))
                 return finish(take_host(cold_idx))
 
             def naive_full():
@@ -517,7 +552,7 @@ class Feature:
                 from .ops.dedup import unique_within_budget
                 valid_pos = (ids_raw >= 0) if masked else None
                 uniq, inv, n_uniq = unique_within_budget(
-                    t, budget, valid=valid_pos)
+                    t, budget, valid=valid_pos, collector=collector)
                 safe_u = jnp.clip(uniq, 0, total - 1)
                 hot_u = safe_u < cache_rows
                 hot_rows_u = take_hot(jnp.where(hot_u, safe_u, 0))
@@ -546,9 +581,25 @@ class Feature:
 
             return finish(compacted_lookup())
 
+        def lookup_tiered(dev_part, host_part, ids, order, masked=False,
+                          collect=False):
+            """The fused tiered lookup; ``collect=True`` (static) adds
+            the device counter vector (``metrics.NUM_COUNTERS`` int32:
+            hot/cold row counts, dedup dup stats) as a second output —
+            pure jnp accumulation on masks the lookup already computes,
+            so rows are bit-identical and no host sync is added."""
+            if not collect:
+                return lookup_tiered_body(dev_part, host_part, ids,
+                                          order, masked)
+            from .metrics import Collector
+            col = Collector()
+            rows = lookup_tiered_body(dev_part, host_part, ids, order,
+                                      masked, col)
+            return rows, col.counters()
+
         self._lookup_tiered_raw = lookup_tiered
         self._lookup_tiered = jax.jit(lookup_tiered,
-                                      static_argnums=(4,))
+                                      static_argnums=(4, 5))
 
     # -- lookup (reference feature.py:296-333) ------------------------------
     def __getitem__(self, node_idx):
@@ -621,6 +672,74 @@ class Feature:
         safe = jnp.clip(ids, 0, self.size(0) - 1)
         rows = self[safe]
         return rows * (ids >= 0).astype(rows.dtype)[:, None]
+
+    def lookup_tiered(self, node_idx, masked=False,
+                      collect_metrics=False):
+        """Tiered lookup with opt-in telemetry: returns ``rows``, or
+        ``(rows, counters)`` with ``collect_metrics=True`` — a
+        ``metrics.NUM_COUNTERS`` int32 vector carrying the OBSERVED
+        hot/cold row counts (actual hit rate vs the
+        ``plan_hot_capacity`` prediction) and, with ``dedup_cold``, the
+        batch's dup statistics. On the fused offload path the counters
+        are a device array accumulated inside the one dispatch (zero
+        host syncs; rows bit-identical to the metrics-off lookup), and
+        a pure-HBM store counts on device too (every valid slot is
+        hot); the numpy/disk tiers — which round-trip through the host
+        anyway — return a numpy vector computed alongside (dup
+        STATISTICS only: those tiers never run a compaction, so the
+        dedup call/overflow event slots stay zero there). Feed either
+        to ``metrics.StepStats.add_counters``."""
+        ids = jnp.asarray(node_idx)
+        if not collect_metrics:
+            return self.getitem_masked(ids) if masked else self[ids]
+        if self._host_offload is not None and self.mmap_array is None:
+            return self._lookup_tiered(self.device_part,
+                                       self._host_offload, ids,
+                                       self.feature_order, masked, True)
+        if (self.host_part is None and self._host_offload is None
+                and self.mmap_array is None):
+            # pure-HBM store: everything valid is a hot-tier hit
+            from . import metrics as _m
+            rows = self.getitem_masked(ids) if masked else self[ids]
+            col = _m.Collector()
+            col.add(_m.LOOKUP_CALLS, 1)
+            col.add(_m.HOT_ROWS,
+                    (ids >= 0).sum() if masked else ids.shape[0])
+            return rows, col.counters()
+        rows = self.getitem_masked(ids) if masked else self[ids]
+        from . import metrics as _m
+        ids_np = np.asarray(jax.device_get(ids)).astype(np.int64)
+        valid = (ids_np >= 0) if masked else np.ones_like(ids_np, bool)
+        if self.feature_order is not None:
+            # the order is immutable once built and O(n_nodes) — cache
+            # its host copy (keyed by identity so a rebuilt store
+            # invalidates) instead of a full D2H transfer per lookup
+            if (self._order_np is None
+                    or self._order_np[0] is not self.feature_order):
+                self._order_np = (self.feature_order,
+                                  np.asarray(jax.device_get(
+                                      self.feature_order)))
+            order = self._order_np[1]
+            t = order[np.clip(ids_np, 0, order.shape[0] - 1)]
+        else:
+            t = np.clip(ids_np, 0, max(self.size(0) - 1, 0))
+        vec = np.zeros((_m.NUM_COUNTERS,), np.int32)
+        hot = int(((t < self.cache_rows) & valid).sum())
+        vec[_m.LOOKUP_CALLS] = 1
+        vec[_m.HOT_ROWS] = hot
+        vec[_m.COLD_ROWS] = int(valid.sum()) - hot
+        if self.dedup_cold:
+            budget = _resolve_cold_budget(self.dedup_cold,
+                                          self.cold_budget,
+                                          int(ids_np.shape[0]))
+            # mirror the fused path's gate (budget >= n short-circuits
+            # to the full gather before any dedup runs) but record only
+            # the dup STATISTICS — this tier never runs a compaction,
+            # so claiming calls/overflow events would be false
+            if budget < int(ids_np.shape[0]):
+                vec[_m.DEDUP_TOTAL] = int(valid.sum())
+                vec[_m.DEDUP_UNIQUE] = int(np.unique(t[valid]).size)
+        return rows, vec
 
     def prefetch(self, node_idx):
         """Start this lookup on the staging pipeline and return a
@@ -890,7 +1009,8 @@ class DistFeature:
     """
 
     def __init__(self, feature: Optional[Feature], info: PartitionInfo,
-                 comm, dedup_cold=False, exchange_cap=None):
+                 comm, dedup_cold=False, exchange_cap=None,
+                 collect_metrics=False):
         self.feature = feature
         self.info = info
         self.comm = comm
@@ -912,6 +1032,14 @@ class DistFeature:
         # info.plan_exchange_cap(...).cap. Composes with dedup_cold
         # (the compact table then sees the already-unique ids).
         self.exchange_cap = exchange_cap
+        # collect_metrics: the SPMD lookup program also emits the
+        # [H, metrics.NUM_COUNTERS] device counter block (fallback
+        # flag, peak bucket load vs cap, dup stats), stashed on
+        # ``self.last_counters`` after each lookup — a device array,
+        # read it lazily (metrics.StepStats.add_counters) to keep the
+        # lookup sync-free. Rows are bit-identical either way.
+        self.collect_metrics = bool(collect_metrics)
+        self.last_counters = None
         self._spmd_feat = None         # [H*rows_per_host, dim], P(axis)
         self._rows_per_host = None
         self._lookup_fns = {}
@@ -921,7 +1049,8 @@ class DistFeature:
     def from_partition(cls, feat, info: PartitionInfo, comm,
                        dtype=None, dedup_cold=False,
                        dtype_policy=None,
-                       exchange_cap=None) -> "DistFeature":
+                       exchange_cap=None,
+                       collect_metrics=False) -> "DistFeature":
         """Build the SPMD store from the FULL feature array + partition
         metadata: each host's rows land in its shard (replicated nodes
         also in every host's tail), row-sharded over ``comm.mesh``.
@@ -933,7 +1062,9 @@ class DistFeature:
         ``exchange_cap`` (``True | int | None``) additionally compacts
         the collectives themselves to a deduplicated [H, cap] request
         block (see ``__init__``) — the two knobs multiply: narrow rows
-        x one crossing per distinct remote row.
+        x one crossing per distinct remote row. ``collect_metrics=True``
+        makes every lookup also emit the device counter block (see
+        ``__init__``; stashed on ``last_counters``).
         """
         if comm.mesh is None:
             raise ValueError("from_partition needs a comm with a mesh")
@@ -957,7 +1088,8 @@ class DistFeature:
         axis = comm.axis
         sharding = NamedSharding(comm.mesh, P(axis))
         self = cls(None, info, comm, dedup_cold=dedup_cold,
-                   exchange_cap=exchange_cap)
+                   exchange_cap=exchange_cap,
+                   collect_metrics=collect_metrics)
         self._spmd_feat = quant.tree_map_tier(
             lambda a: jax.device_put(a, sharding),
             quant.quantize(store.reshape(hosts * rows_per_host, dim),
@@ -1036,8 +1168,9 @@ class DistFeature:
             cap = int(cap)
         # dtype passed EXPLICITLY from the store's payload (a bf16 or
         # quantized store must never silently upcast to an fp32 default)
+        collect = self.collect_metrics
         key = (b, quant.tier_key(self._spmd_feat),
-               self._rep_args is not None, cap)
+               self._rep_args is not None, cap, collect)
         fn = self._lookup_fns.get(key)
         if fn is None:
             from .comm import build_dist_lookup_fn
@@ -1045,12 +1178,15 @@ class DistFeature:
                 self.comm.mesh, self.comm.axis, self._rows_per_host, b,
                 quant.tier_dtype(self._spmd_feat),
                 with_replicate=self._rep_args is not None,
-                exchange_cap=cap)
+                exchange_cap=cap, collect_metrics=collect)
             self._lookup_fns[key] = fn
         args = (ids, self.info.global2host.astype(jnp.int32),
                 self.info.global2local, self._spmd_feat)
         if self._rep_args is not None:
             args += self._rep_args
+        if collect:
+            out, self.last_counters = fn(*args)
+            return out
         return fn(*args)
 
     def __getitem__(self, ids):

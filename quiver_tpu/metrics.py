@@ -1,0 +1,459 @@
+"""Runtime telemetry: jit-safe device counters, step stats, JSONL sinks.
+
+Every adaptive mechanism in this package is *sized* from expected
+distributions (``plan_hot_capacity`` predicts a hot-tier hit rate,
+``plan_exchange_cap`` picks a 3-sigma per-owner headroom, ``dedup_cold``
+pays off only past a duplicate factor of ~1.3) and then runs blind.
+This module closes the loop with two halves:
+
+**Device side** — a fixed-slot int32 counter vector accumulated with
+pure ``jnp`` ops while a hot path traces (:class:`Collector`). The
+instrumented paths (``Feature.lookup_tiered``, ``ops.dedup``,
+``comm.dist_lookup_local``, ``ops.sample_multihop``) take an opt-in
+``collector`` and record what they already computed — the hot/cold
+classification mask, the unique count, the pmax'd fallback flag, the
+per-owner bucket loads — so collection adds **zero host syncs per
+step**, never touches a ``lax.cond`` predicate, and leaves donation
+intact. The counters ride out of the jitted step as ONE auxiliary
+int32 array (``[NUM_COUNTERS]``, or ``[shards, NUM_COUNTERS]`` from a
+``shard_map`` step); losses are bit-identical with metrics on or off
+(pinned in tests/test_metrics.py).
+
+**Host side** — :class:`StepStats` merges those vectors (lazily, in
+int64, without blocking on the in-flight step) with wall-clock step
+latency (streaming log-bucketed histogram -> p50/p95/p99), pipeline
+queue depth/wait (``quiver_tpu.pipeline.Pipeline.stats``), and
+recompile detection (jit executable-cache deltas of watched
+functions). :class:`MetricsSink` emits the one structured JSONL record
+schema shared by ``bench.py``, ``scripts/check_leak.py`` and the
+benchmark watch scripts; ``report()`` renders the same snapshot for
+interactive use.
+
+JSONL record schema (one object per line)::
+
+    {"ts": <unix seconds>, "kind": "<record kind>", ...payload}
+
+Record kinds emitted in-tree: ``step_stats`` (StepStats.snapshot()),
+``bench`` (bench.py's measurement record), ``canary``
+(benchmarks/canary.py's usability probe). Consumers key on ``kind``
+and must ignore unknown fields.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# -- the device counter vector ---------------------------------------------
+#
+# Fixed slot layout: ONE int32 vector per step, so adding a counter is
+# an append here, not a schema migration everywhere. Per-step values
+# are small (bounded by frontier caps); long-run accumulation happens
+# host-side in int64 (StepStats).
+
+HOT_ROWS = 0          # valid tiered-lookup slots served from the HBM tier
+COLD_ROWS = 1         # valid tiered-lookup slots served from the cold tier
+LOOKUP_CALLS = 2      # tiered lookups recorded
+DEDUP_TOTAL = 3       # valid ids entering a dedup compaction
+DEDUP_UNIQUE = 4      # true distinct count found (may exceed the budget)
+DEDUP_OVERFLOW = 5    # dedup budget overflows (full-gather fallbacks)
+EXCH_CALLS = 6        # cross-host exchange lookups
+EXCH_FALLBACK = 7     # compact-exchange dense fallbacks taken
+EXCH_BUCKET_MAX = 8   # peak per-owner request-bucket load       [max slot]
+EXCH_CAP = 9          # the per-owner cap in force               [max slot]
+FRONTIER_VALID = 10   # valid final-frontier slots out of sampling
+FRONTIER_CAP = 11     # static final-frontier capacity
+DEDUP_CALLS = 12      # dedup compactions recorded
+
+NUM_COUNTERS = 16     # slots 13..15 reserved
+
+#: slots merged with ``max`` across steps/shards; all others add
+MAX_SLOTS = (EXCH_BUCKET_MAX, EXCH_CAP)
+
+SLOT_NAMES = {
+    HOT_ROWS: "hot_rows", COLD_ROWS: "cold_rows",
+    LOOKUP_CALLS: "lookup_calls", DEDUP_TOTAL: "dedup_total",
+    DEDUP_UNIQUE: "dedup_unique", DEDUP_OVERFLOW: "dedup_overflow",
+    EXCH_CALLS: "exchange_calls", EXCH_FALLBACK: "exchange_fallback",
+    EXCH_BUCKET_MAX: "exchange_bucket_max", EXCH_CAP: "exchange_cap",
+    FRONTIER_VALID: "frontier_valid", FRONTIER_CAP: "frontier_cap",
+    DEDUP_CALLS: "dedup_calls",
+}
+
+_MAX_MASK_NP = np.zeros((NUM_COUNTERS,), bool)
+_MAX_MASK_NP[list(MAX_SLOTS)] = True
+
+
+class Collector:
+    """Trace-time accumulator for the device counter vector.
+
+    Create ONE per trace (inside the function being jitted — a
+    collector that outlives a trace would leak stale tracers into the
+    next one), hand it down the hot path, and materialize the vector
+    with :meth:`counters` as an auxiliary output of the step.
+
+    ``add``/``peak`` values must be computed OUTSIDE ``lax.cond``
+    branches (the instrumented paths all compute their predicates and
+    loads before branching, so this costs nothing); integer/bool
+    scalars only — the loss path must not depend on anything recorded
+    here.
+    """
+
+    def __init__(self):
+        self._entries: List[tuple] = []
+
+    def add(self, slot: int, value) -> None:
+        """Accumulate ``value`` into an additive slot."""
+        self._entries.append((int(slot), value, False))
+
+    def peak(self, slot: int, value) -> None:
+        """Merge ``value`` into a max slot."""
+        self._entries.append((int(slot), value, True))
+
+    def counters(self) -> jax.Array:
+        """Materialize the ``[NUM_COUNTERS]`` int32 vector."""
+        vec = jnp.zeros((NUM_COUNTERS,), jnp.int32)
+        for slot, val, is_max in self._entries:
+            v = jnp.asarray(val).astype(jnp.int32)
+            vec = vec.at[slot].max(v) if is_max else vec.at[slot].add(v)
+        return vec
+
+
+def merge_counters(a, b):
+    """Merge two counter vectors (jnp): add, except ``MAX_SLOTS``."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    return jnp.where(jnp.asarray(_MAX_MASK_NP), jnp.maximum(a, b), a + b)
+
+
+def reduce_counters(stack) -> np.ndarray:
+    """Host-side fold of ``[..., NUM_COUNTERS]`` stacked vectors (e.g. a
+    shard_map step's per-shard ``[H, N]`` output) into one int64
+    vector: sum over leading axes, max on ``MAX_SLOTS``."""
+    arr = np.asarray(jax.device_get(stack)).astype(np.int64)
+    arr = arr.reshape(-1, NUM_COUNTERS)
+    summed = arr.sum(axis=0)
+    peaked = arr.max(axis=0, initial=0)
+    return np.where(_MAX_MASK_NP, peaked, summed)
+
+
+def derive(counters) -> Dict[str, Optional[float]]:
+    """Observed ratios from a (host) counter vector — the numbers the
+    planners predicted: hot-tier hit rate, frontier duplicate factor,
+    dedup/fallback rates, per-owner bucket headroom, frontier fill.
+    ``None`` where the denominator never moved (path not exercised)."""
+    c = np.asarray(jax.device_get(counters)).astype(np.float64)
+    if c.ndim > 1:
+        c = reduce_counters(c).astype(np.float64)
+
+    def ratio(num, den):
+        return float(num / den) if den > 0 else None
+
+    return {
+        "hot_hit_rate": ratio(c[HOT_ROWS], c[HOT_ROWS] + c[COLD_ROWS]),
+        "dup_factor": ratio(c[DEDUP_TOTAL], c[DEDUP_UNIQUE]),
+        "dedup_overflow_rate": ratio(c[DEDUP_OVERFLOW], c[DEDUP_CALLS]),
+        "exchange_fallback_rate": ratio(c[EXCH_FALLBACK], c[EXCH_CALLS]),
+        "exchange_bucket_peak_frac": ratio(c[EXCH_BUCKET_MAX], c[EXCH_CAP]),
+        "frontier_fill": ratio(c[FRONTIER_VALID], c[FRONTIER_CAP]),
+    }
+
+
+def counters_dict(counters) -> Dict[str, int]:
+    """Named raw counters (host ints) for JSONL payloads."""
+    c = reduce_counters(counters)
+    return {name: int(c[slot]) for slot, name in SLOT_NAMES.items()}
+
+
+# -- host-side aggregation --------------------------------------------------
+
+
+class _Histogram:
+    """Streaming log2-bucketed latency histogram: O(1) memory, add is
+    one ``frexp``; quantiles come from the cumulative bucket counts
+    with log-linear interpolation inside the landing bucket."""
+
+    _LO = 1e-6            # 1 us floor; anything faster lands in bucket 0
+
+    def __init__(self):
+        self.counts: Dict[int, int] = {}
+        self.n = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def add(self, x: float) -> None:
+        x = max(float(x), 0.0)
+        self.n += 1
+        self.total += x
+        self.max = max(self.max, x)
+        b = 0 if x < self._LO else int(math.log2(x / self._LO)) + 1
+        self.counts[b] = self.counts.get(b, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        if not self.n:
+            return 0.0
+        target = q * self.n
+        seen = 0.0
+        for b in sorted(self.counts):
+            cnt = self.counts[b]
+            if seen + cnt >= target:
+                lo = 0.0 if b == 0 else self._LO * 2.0 ** (b - 1)
+                hi = self._LO * 2.0 ** b
+                frac = (target - seen) / cnt
+                return min(lo + (hi - lo) * frac, self.max)
+            seen += cnt
+        return self.max
+
+
+class StepStats:
+    """Merges device counters with host-observed step facts.
+
+    ``record_step(duration_s, counters=None)`` files one step: the
+    latency lands in the streaming histogram; the counter vector (a
+    device array — ``[N]`` or a shard_map step's ``[H, N]``) is queued
+    and folded into an int64 total LAZILY (every ``fold_every`` steps),
+    so recording neither blocks on the in-flight step nor overflows
+    int32 over long runs.
+
+    ``watch_compiles(*fns)`` registers jitted functions (anything with
+    a ``_cache_size()``, e.g. ``build_train_step(...).jitted_fns``)
+    whose executable-cache growth is reported as ``recompiles`` — a
+    static-shape regression shows up here as a nonzero delta long
+    before memory pressure would.
+
+    ``watch_pipeline(p)`` folds a ``quiver_tpu.pipeline.Pipeline``'s
+    queue depth/wait stats into the snapshot.
+    """
+
+    def __init__(self, fold_every: int = 64):
+        self._fold_every = max(int(fold_every), 1)
+        self._hist = _Histogram()
+        self._pending: List = []
+        self._counters = np.zeros((NUM_COUNTERS,), np.int64)
+        self._steps = 0
+        self._compile_fns: List = []
+        self._compile_base: Optional[int] = None
+        self._pipelines: List = []
+        self._lock = threading.Lock()
+
+    # -- recording ----------------------------------------------------------
+    def record_step(self, duration_s: float, counters=None) -> None:
+        with self._lock:
+            self._steps += 1
+            self._hist.add(duration_s)
+            if counters is not None:
+                self._pending.append(counters)
+                if len(self._pending) > self._fold_every:
+                    self._fold_locked(keep=1)
+
+    def add_counters(self, counters) -> None:
+        """File a counter vector not tied to a timed step (e.g. a
+        standalone lookup's aux output)."""
+        with self._lock:
+            self._pending.append(counters)
+            if len(self._pending) > self._fold_every:
+                self._fold_locked(keep=1)
+
+    def _fold_locked(self, keep: int = 0) -> None:
+        # keep=1 on the recording path: the just-filed vector belongs to
+        # the step still in flight — device_get on it would block the
+        # host on that step, the one stall the lazy fold exists to avoid
+        if keep:
+            pending = self._pending[:-keep]
+            self._pending = self._pending[-keep:]
+        else:
+            pending, self._pending = self._pending, []
+        for c in pending:
+            vec = reduce_counters(c)
+            self._counters = np.where(_MAX_MASK_NP,
+                                      np.maximum(self._counters, vec),
+                                      self._counters + vec)
+
+    # -- watches ------------------------------------------------------------
+    def watch_compiles(self, *fns) -> "StepStats":
+        # baseline only the newly registered fns: re-deriving it from
+        # the full cache totals would erase recompiles already observed
+        # on earlier registrations. Re-registering a watched fn (e.g.
+        # per epoch) is a no-op — double entries would multiply every
+        # real recompile by the registration count.
+        known = {id(f) for f in self._compile_fns}
+        new = [f for f in fns
+               if hasattr(f, "_cache_size") and id(f) not in known]
+        self._compile_base = ((self._compile_base or 0)
+                              + sum(f._cache_size() for f in new))
+        self._compile_fns += new
+        return self
+
+    def _cache_total(self) -> int:
+        return sum(f._cache_size() for f in self._compile_fns)
+
+    def watch_pipeline(self, pipeline) -> "StepStats":
+        self._pipelines.append(pipeline)
+        return self
+
+    # -- reading ------------------------------------------------------------
+    def counters(self) -> np.ndarray:
+        with self._lock:
+            self._fold_locked()
+            return self._counters.copy()
+
+    def snapshot(self) -> dict:
+        """One JSONL-ready record (kind ``step_stats``): step latency
+        percentiles, accumulated raw counters, the derived ratios, the
+        recompile delta, and merged pipeline queue stats."""
+        with self._lock:
+            self._fold_locked()
+            h = self._hist
+            rec = {
+                "steps": self._steps,
+                "wall": {
+                    "total_s": round(h.total, 6),
+                    "mean_ms": round(1e3 * h.total / h.n, 3) if h.n else 0.0,
+                    "p50_ms": round(1e3 * h.quantile(0.50), 3),
+                    "p95_ms": round(1e3 * h.quantile(0.95), 3),
+                    "p99_ms": round(1e3 * h.quantile(0.99), 3),
+                    "max_ms": round(1e3 * h.max, 3),
+                },
+                "counters": counters_dict(self._counters),
+                "derived": derive(self._counters),
+            }
+        if self._compile_fns:
+            rec["recompiles"] = self._cache_total() - self._compile_base
+        if self._pipelines:
+            # counts and wait totals add across pipelines; peaks and the
+            # instantaneous depth take max; the mean is re-derived from
+            # the merged totals (summing per-pipeline means would
+            # inflate it)
+            merged: Dict[str, float] = {}
+            for p in self._pipelines:
+                for k, v in p.stats().items():
+                    if k == "mean_wait_s":
+                        continue
+                    merged[k] = max(merged.get(k, 0), v) \
+                        if (k.startswith("max_") or k == "depth") \
+                        else merged.get(k, 0) + v
+            done = merged.get("completed", 0) + merged.get("failed", 0)
+            merged["mean_wait_s"] = (merged.get("total_wait_s", 0.0) / done
+                                     if done else 0.0)
+            rec["queue"] = merged
+        return rec
+
+    def report(self) -> str:
+        """Human-readable rendering of :meth:`snapshot`."""
+        s = self.snapshot()
+        w, d, c = s["wall"], s["derived"], s["counters"]
+        fmt = lambda v, pct=False: ("n/a" if v is None else
+                                    f"{100.0 * v:.1f}%" if pct
+                                    else f"{v:.2f}")
+        lines = [
+            f"steps: {s['steps']}  "
+            f"(p50 {w['p50_ms']:.2f} ms, p95 {w['p95_ms']:.2f} ms, "
+            f"p99 {w['p99_ms']:.2f} ms, mean {w['mean_ms']:.2f} ms)",
+            f"hot-tier hit rate: {fmt(d['hot_hit_rate'], pct=True)}  "
+            f"({c['hot_rows']} hot / {c['cold_rows']} cold rows)",
+            f"frontier dup factor: {fmt(d['dup_factor'])}  "
+            f"(dedup overflow rate {fmt(d['dedup_overflow_rate'], pct=True)})",
+            f"exchange fallback rate: "
+            f"{fmt(d['exchange_fallback_rate'], pct=True)}  "
+            f"(peak bucket {c['exchange_bucket_max']}/{c['exchange_cap']}"
+            f" = {fmt(d['exchange_bucket_peak_frac'], pct=True)} of cap)",
+            f"frontier fill: {fmt(d['frontier_fill'], pct=True)}",
+        ]
+        if "recompiles" in s:
+            lines.append(f"recompiles since watch: {s['recompiles']}")
+        if "queue" in s:
+            q = s["queue"]
+            lines.append("pipeline: " + ", ".join(
+                f"{k}={round(v, 4)}" for k, v in sorted(q.items())))
+        return "\n".join(lines)
+
+
+# -- structured emission ----------------------------------------------------
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, (np.ndarray, jax.Array)):
+        return np.asarray(jax.device_get(o)).tolist()
+    return str(o)
+
+
+class MetricsSink:
+    """Append-only JSONL emitter — the one record schema shared by the
+    interactive ``report()``, ``bench.py``'s measurement line, and the
+    long-running watch logs (``benchmarks/chip_watch.sh``'s canary).
+
+    ``path`` is a filesystem path (opened append) or any file-like with
+    ``write``. Every record gains ``ts`` (unix seconds) and ``kind``.
+    """
+
+    def __init__(self, path, kind: str = "record"):
+        self._own = isinstance(path, (str, bytes, os.PathLike))
+        self._f = open(path, "a") if self._own else path
+        self._kind = kind
+        self._lock = threading.Lock()
+
+    def emit(self, record: dict, kind: Optional[str] = None) -> dict:
+        rec = {"ts": round(time.time(), 3),
+               "kind": kind or record.get("kind", self._kind)}
+        rec.update({k: v for k, v in record.items() if k != "kind"})
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+        return rec
+
+    def emit_stats(self, stats: StepStats, kind: str = "step_stats") -> dict:
+        return self.emit(stats.snapshot(), kind=kind)
+
+    def close(self) -> None:
+        if self._own:
+            self._f.close()
+
+    def __enter__(self) -> "MetricsSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- interactive convenience ------------------------------------------------
+
+_default_stats: Optional[StepStats] = None
+_default_lock = threading.Lock()
+
+
+def stats() -> StepStats:
+    """The process-default :class:`StepStats` (created on first use) —
+    the aggregator ``report()`` reads when given nothing."""
+    global _default_stats
+    with _default_lock:
+        if _default_stats is None:
+            _default_stats = StepStats()
+        return _default_stats
+
+
+def report(obj=None) -> str:
+    """Render a telemetry summary: a :class:`StepStats` (default: the
+    process-default one), or a raw counter vector/stack."""
+    if obj is None:
+        obj = stats()
+    if isinstance(obj, StepStats):
+        return obj.report()
+    c = reduce_counters(obj)
+    d = derive(c)
+    named = counters_dict(c)
+    parts = [f"{k}={v}" for k, v in named.items() if v]
+    parts += [f"{k}={v:.3f}" for k, v in d.items() if v is not None]
+    return "counters: " + (", ".join(parts) if parts else "(empty)")

@@ -24,7 +24,8 @@ I32_MAX = jnp.iinfo(jnp.int32).max
 _I32_MAX = I32_MAX          # back-compat alias (fill value, public)
 
 
-def unique_within_budget(ids: jax.Array, budget: int, valid=None):
+def unique_within_budget(ids: jax.Array, budget: int, valid=None,
+                         collector=None):
     """Compact the distinct values of ``ids`` into a static-size table.
 
     Returns ``(uniq, inv, n_uniq)``:
@@ -43,6 +44,11 @@ def unique_within_budget(ids: jax.Array, budget: int, valid=None):
     excluded slots neither consume budget nor get a meaningful ``inv``.
     Positions are excluded by keying them to int32 max, so ids must stay
     below it (node/row ids always do).
+
+    ``collector`` (optional ``metrics.Collector``) records the observed
+    dup statistics — counted ids, true distinct count, and whether the
+    budget overflowed — with pure jnp ops on values this function
+    already computes (no host sync, no effect on the returned arrays).
 
     Cost note: sorting the VALUES alone and recovering ``inv`` with a
     ``searchsorted`` over the (sorted) unique table measures ~2.3x
@@ -64,11 +70,19 @@ def unique_within_budget(ids: jax.Array, budget: int, valid=None):
         skey, mode="drop")
     inv = jnp.clip(jnp.searchsorted(uniq, key), 0,
                    budget - 1).astype(jnp.int32)
+    if collector is not None:
+        from ..metrics import (DEDUP_CALLS, DEDUP_OVERFLOW, DEDUP_TOTAL,
+                               DEDUP_UNIQUE)
+        total = n if valid is None else jnp.sum(valid)
+        collector.add(DEDUP_CALLS, 1)
+        collector.add(DEDUP_TOTAL, total)
+        collector.add(DEDUP_UNIQUE, n_uniq)
+        collector.add(DEDUP_OVERFLOW, n_uniq > budget)
     return uniq, inv, n_uniq
 
 
 def dedup_take(table: jax.Array, ids: jax.Array, budget: int,
-               valid=None) -> jax.Array:
+               valid=None, collector=None) -> jax.Array:
     """``jnp.take(table, ids, axis=0)`` reading each distinct id ONCE.
 
     The only ``table``-sized read on the narrow path is a
@@ -93,7 +107,8 @@ def dedup_take(table: jax.Array, ids: jax.Array, budget: int,
         table, jnp.clip(t_ids, 0, max(rows - 1, 0)))
     if budget >= n:
         return take(ids)
-    uniq, inv, n_uniq = unique_within_budget(ids, budget, valid=valid)
+    uniq, inv, n_uniq = unique_within_budget(ids, budget, valid=valid,
+                                             collector=collector)
 
     def narrow(_):
         uniq_rows = take(uniq)                          # [budget, dim]

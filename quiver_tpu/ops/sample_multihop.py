@@ -26,6 +26,7 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
                     seeds_dense: bool = False,
                     weight_rows: jax.Array | None = None,
                     hub_frac: float | None = None,
+                    collector=None,
                     ) -> Tuple[jax.Array, List[LayerSample]]:
     """Expand ``seeds`` through ``sizes`` hops. Returns the final frontier
     ``n_id`` (static cap, -1 fill) and the per-hop LayerSamples in
@@ -85,6 +86,11 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
     positions; under rotation pass the co-permuted map built from
     ``permute_csr(..., with_slot_map=True)``). The ids land in each
     ``LayerSample.e_id`` (-1 fill).
+
+    ``collector`` (optional ``metrics.Collector``) records the final
+    frontier's fill — valid slots vs the static cap, the number the
+    dedup budgets and exchange caps are sized against — with one jnp
+    reduction on the returned ``n_id`` (no host sync, output unchanged).
     """
     cur = seeds.astype(jnp.int32)
     track_eid = eid is not None
@@ -136,6 +142,9 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
             indices_rows = as_rows(permute_csr(indices, rids, pkey))
     layers: List[LayerSample] = []
     for i, k in enumerate(sizes):
+      # named scope per hop: XProf traces attribute time to hop stages
+      # instead of one opaque multihop blob
+      with jax.named_scope(f"qt_sample_hop{i}"):
         sub = jax.random.fold_in(key, i)
         slots = None
         if edge_weight is not None and windowed and weight_rows is not None:
@@ -188,6 +197,10 @@ def sample_multihop(indptr: jax.Array, indices: jax.Array, seeds: jax.Array,
             layer = layer._replace(e_id=jnp.where(flat >= 0, ids, -1))
         layers.append(layer)
         cur = layer.n_id
+    if collector is not None:
+        from ..metrics import FRONTIER_CAP, FRONTIER_VALID
+        collector.add(FRONTIER_VALID, jnp.sum(cur >= 0))
+        collector.add(FRONTIER_CAP, int(cur.shape[0]))
     return cur, layers
 
 

@@ -33,8 +33,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..comm import default_exchange_cap, dist_lookup_local
 from ..pyg.sage_sampler import layer_shapes
 from .train import (TrainState, _check_donatable, _check_rows,
-                    _fused_loss, _pmean_update, cross_entropy_logits,
-                    _DONATED_DOC)
+                    _fused_loss, _metered_loss_fn, _pmean_update,
+                    cross_entropy_logits, _COLLECT_DOC, _DONATED_DOC)
 
 
 def build_dist_train_step(model, tx, sizes: Sequence[int],
@@ -47,7 +47,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                           with_replicate: bool = False,
                           hub_frac: float | None = None,
                           donate: bool = True,
-                          exchange_cap=None):
+                          exchange_cap=None,
+                          collect_metrics: bool = False):
     """fn(state, spmd_feat, g2h, g2l, indptr, indices, seeds, labels,
     key[, indices_rows][, is_rep, rep_rank, bases]) -> (state, loss).
 
@@ -101,7 +102,7 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                 (extra if with_replicate else None)
             key = jax.random.fold_in(key, jax.lax.axis_index(axis))
 
-            def gather(feat_, n_id, _forder):
+            def gather(feat_, n_id, _forder, collector=None):
                 # dtype=None: the lookup resolves the store's own
                 # dequantized dtype — a bf16 or quantized spmd_feat
                 # must not upcast through an fp32 default, and a
@@ -109,16 +110,24 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                 return dist_lookup_local(n_id, g2h, g2l, feat_, axis,
                                          h_count, rows_per_host,
                                          rep=rep or None,
-                                         exchange_cap=exchange_cap)
+                                         exchange_cap=exchange_cap,
+                                         collector=collector)
 
-            loss, grads = jax.value_and_grad(
-                lambda p: _fused_loss(model, loss_fn, sizes, per_host_batch,
-                                      p, feat, None, indptr, indices, seeds,
-                                      labels, key, method, rows,
-                                      indices_stride, gather=gather,
-                                      hub_frac=hub_frac)
-            )(state.params)
-            return _pmean_update(state, tx, grads, loss, axis)
+            loss_of, unpack = _metered_loss_fn(
+                collect_metrics,
+                lambda p, col: _fused_loss(model, loss_fn, sizes,
+                                           per_host_batch, p, feat, None,
+                                           indptr, indices, seeds, labels,
+                                           key, method, rows,
+                                           indices_stride, gather=gather,
+                                           hub_frac=hub_frac,
+                                           collector=col))
+            loss, counters, grads = unpack(loss_of(state.params))
+            new_state, loss = _pmean_update(state, tx, grads, loss, axis)
+            if collect_metrics:
+                # per-shard counters, [1, N] here -> [H, N] outside
+                return new_state, loss, counters[None]
+            return new_state, loss
 
         return per_shard
 
@@ -131,7 +140,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
         return jax.jit(shard_map(
             make_per_shard(has_rows), mesh=mesh,
             in_specs=tuple(specs),
-            out_specs=(P(), P()),
+            out_specs=(P(), P(), P(axis)) if collect_metrics
+            else (P(), P()),
             check_vma=False), donate_argnums=(0,) if donate else ())
 
     jitted_by_rows = {True: make_jitted(True), False: make_jitted(False)}
@@ -158,8 +168,9 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
         return jitted(state, feat, g2h, g2l, indptr, indices, seeds,
                       labels, key, *extra)
 
+    step.jitted_fns = tuple(jitted_by_rows.values())
     return step
 
 
 if build_dist_train_step.__doc__:        # None under python -OO
-    build_dist_train_step.__doc__ += _DONATED_DOC
+    build_dist_train_step.__doc__ += _DONATED_DOC + _COLLECT_DOC

@@ -52,14 +52,16 @@ def layers_to_adjs(layers, batch_size: int, sizes: Sequence[int]):
 
 
 def masked_feature_gather(feat, n_id: jax.Array,
-                          feature_order=None) -> jax.Array:
+                          feature_order=None,
+                          collector=None) -> jax.Array:
     """Feature rows for a -1-padded frontier, through the optional
     hot-order indirection (reference feature.py:296-301); padded rows
     come back zeroed so aggregation stays exact. ``feat`` may be a
     plain array or a quantized store (``ops.quant`` — e.g.
     ``quant.quantize(feat, "int8")``): dequantization fuses into the
     gather, so the step reads narrow rows + sidecars and the model
-    consumes float activations unchanged."""
+    consumes float activations unchanged. ``collector`` is accepted for
+    gather-protocol uniformity (single-tier: nothing tiered to count)."""
     from ..ops import quant
     ids = n_id
     if feature_order is not None:
@@ -71,7 +73,8 @@ def masked_feature_gather(feat, n_id: jax.Array,
 
 def dedup_feature_gather(feat, n_id: jax.Array,
                          feature_order=None,
-                         budget: int | None = None) -> jax.Array:
+                         budget: int | None = None,
+                         collector=None) -> jax.Array:
     """``masked_feature_gather`` reading each distinct valid id ONCE:
     the frontier's -1 padding (the bulk of a static multi-hop cap) and
     any repeated ids collapse into a static-``budget`` unique table,
@@ -87,7 +90,8 @@ def dedup_feature_gather(feat, n_id: jax.Array,
     if budget >= n:
         return masked_feature_gather(feat, n_id, feature_order)
     valid = n_id >= 0
-    uniq, inv, n_uniq = unique_within_budget(n_id, budget, valid=valid)
+    uniq, inv, n_uniq = unique_within_budget(n_id, budget, valid=valid,
+                                             collector=collector)
 
     def narrow(_):
         # uniq's int32-max fill clips to the LAST feature row — those
@@ -107,12 +111,16 @@ def dedup_feature_gather(feat, n_id: jax.Array,
 def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
                 indptr, indices, seeds, labels, key, method="exact",
                 indices_rows=None, indices_stride=None, gather=None,
-                hub_frac=None):
-    """``gather(feat, n_id, forder)`` defaults to the local
-    ``masked_feature_gather``; the multi-host fused step substitutes the
-    partitioned all_to_all lookup. Everything else (sampling keys, the
-    dropout fold constant, the logits slice) is THE shared definition —
-    dist/DP loss parity depends on there being exactly one copy.
+                hub_frac=None, collector=None):
+    """``gather(feat, n_id, forder, collector=None)`` defaults to the
+    local ``masked_feature_gather``; the multi-host fused step
+    substitutes the partitioned all_to_all lookup. Everything else
+    (sampling keys, the dropout fold constant, the logits slice) is THE
+    shared definition — dist/DP loss parity depends on there being
+    exactly one copy. ``collector`` (a ``metrics.Collector``) opts into
+    device-counter telemetry: sampling and the gather record counts
+    they already compute; the loss itself is untouched (bit-identical
+    with collection on or off).
 
     Batch contract: ``seeds`` must be distinct valid ids with -1 padding
     at the TAIL only. That was always required here — ``labels`` are
@@ -122,8 +130,10 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
     n_id, layers = sample_multihop(indptr, indices, seeds, sizes, key,
                                    method=method, indices_rows=indices_rows,
                                    indices_stride=indices_stride,
-                                   seeds_dense=True, hub_frac=hub_frac)
-    x = (gather or masked_feature_gather)(feat, n_id, forder)
+                                   seeds_dense=True, hub_frac=hub_frac,
+                                   collector=collector)
+    x = (gather or masked_feature_gather)(feat, n_id, forder,
+                                          collector=collector)
     adjs = layers_to_adjs(layers, batch_size, sizes)
     logits = model.apply(params, x, adjs, train=True,
                          rngs={"dropout": jax.random.fold_in(key, 1000)})
@@ -214,8 +224,44 @@ def _dedup_gather_fn(dedup_gather):
     if dedup_gather is None:
         return None
     budget = None if dedup_gather is True else int(dedup_gather)
-    return lambda feat, n_id, forder: dedup_feature_gather(
-        feat, n_id, forder, budget)
+    return lambda feat, n_id, forder, collector=None: dedup_feature_gather(
+        feat, n_id, forder, budget, collector=collector)
+
+
+def _metered_loss_fn(collect: bool, loss_with_collector):
+    """Shared value_and_grad plumbing for the ``collect_metrics`` knob:
+    ``loss_with_collector(params, collector_or_None)`` is the loss;
+    with collection on, a fresh ``metrics.Collector`` is created INSIDE
+    the traced function (a collector outliving a trace would leak stale
+    tracers into the next one) and its counter vector rides out as
+    ``has_aux`` — differentiation sees the identical loss either way.
+    Returns ``(loss_of, unpack)`` with
+    ``unpack(loss_of(p)) == (loss, counters_or_None, grads)``."""
+    if collect:
+        from ..metrics import Collector
+
+        def loss_of(p):
+            col = Collector()
+            return loss_with_collector(p, col), col.counters()
+
+        vg = jax.value_and_grad(loss_of, has_aux=True)
+        return vg, lambda out: (out[0][0], out[0][1], out[1])
+    vg = jax.value_and_grad(lambda p: loss_with_collector(p, None))
+    return vg, lambda out: (out[0], None, out[1])
+
+
+_COLLECT_DOC = """
+
+    ``collect_metrics=True`` adds ONE auxiliary output to the step — a
+    ``metrics.NUM_COUNTERS`` int32 device counter vector (per-shard
+    ``[shards, N]`` from the shard_map builders) carrying the observed
+    frontier fill, dedup/dup statistics and exchange branch behavior.
+    Counters accumulate with pure jnp ops on values the hot path
+    already computes: zero host syncs per step, ``lax.cond``
+    predicates untouched, losses bit-identical to the metrics-off step,
+    donation intact. Feed the vectors to ``metrics.StepStats``. The
+    returned step exposes ``.jitted_fns`` (the underlying jitted
+    callables) for ``StepStats.watch_compiles``."""
 
 
 def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
@@ -224,7 +270,8 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                      indices_stride: int | None = None,
                      hub_frac: float | None = None,
                      donate: bool = True,
-                     dedup_gather=None):
+                     dedup_gather=None,
+                     collect_metrics: bool = False):
     """Single-chip fused step:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]). With ``method="rotation"`` pass the shuffled
@@ -246,17 +293,24 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
 
     def step(state: TrainState, feat, forder, indptr, indices, seeds,
              labels, key, indices_rows=None):
-        loss, grads = jax.value_and_grad(
-            lambda p: _fused_loss(model, loss_fn, sizes, batch_size, p, feat,
-                                  forder, indptr, indices, seeds, labels, key,
-                                  method, indices_rows, indices_stride,
-                                  gather=gather, hub_frac=hub_frac)
-        )(state.params)
+        loss_of, unpack = _metered_loss_fn(
+            collect_metrics,
+            lambda p, col: _fused_loss(model, loss_fn, sizes, batch_size,
+                                       p, feat, forder, indptr, indices,
+                                       seeds, labels, key, method,
+                                       indices_rows, indices_stride,
+                                       gather=gather, hub_frac=hub_frac,
+                                       collector=col))
+        loss, counters, grads = unpack(loss_of(state.params))
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        return TrainState(params, opt_state, state.step + 1), loss
+        new_state = TrainState(params, opt_state, state.step + 1)
+        if collect_metrics:
+            return new_state, loss, counters
+        return new_state, loss
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    jitted.jitted_fns = (jitted,)
     if not donate:
         return jitted
     checked = set()
@@ -266,6 +320,7 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
                          *args, **kwargs)
         return jitted(state, *args, **kwargs)
 
+    guarded.jitted_fns = (jitted,)
     return guarded
 
 
@@ -277,7 +332,8 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                          indices_stride: int | None = None,
                          hub_frac: float | None = None,
                          donate: bool = True,
-                         dedup_gather=None):
+                         dedup_gather=None,
+                         collect_metrics: bool = False):
     """Data-parallel fused step over ``mesh[axis]``:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]) with seeds/labels [n_dev * per_device_batch] sharded
@@ -297,28 +353,35 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     def per_shard(state: TrainState, feat, forder, indptr, indices, seeds,
                   labels, key, indices_rows=None):
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
-        loss, grads = jax.value_and_grad(
-            lambda p: _fused_loss(model, loss_fn, sizes, per_device_batch, p,
-                                  feat, forder, indptr, indices, seeds,
-                                  labels, key, method, indices_rows,
-                                  indices_stride, gather=gather,
-                                  hub_frac=hub_frac)
-        )(state.params)
-        return _pmean_update(state, tx, grads, loss, axis)
+        loss_of, unpack = _metered_loss_fn(
+            collect_metrics,
+            lambda p, col: _fused_loss(model, loss_fn, sizes,
+                                       per_device_batch, p, feat, forder,
+                                       indptr, indices, seeds, labels, key,
+                                       method, indices_rows, indices_stride,
+                                       gather=gather, hub_frac=hub_frac,
+                                       collector=col))
+        loss, counters, grads = unpack(loss_of(state.params))
+        new_state, loss = _pmean_update(state, tx, grads, loss, axis)
+        if collect_metrics:
+            # per-shard counters, [1, N] here -> [n_dev, N] outside
+            return new_state, loss, counters[None]
+        return new_state, loss
 
     specs = [P(), P(), P(), P(), P(), P(axis), P(axis), P()]
+    outs = (P(), P(), P(axis)) if collect_metrics else (P(), P())
     # shard_map arity is fixed at build time, but exact may or may not
     # bring the (optional) wide-path rows view — build both arities; jit
     # compiles lazily so the unused one costs nothing
     with_rows = shard_map(
         per_shard, mesh=mesh,
         in_specs=tuple(specs + [P()]),   # indices_rows, replicated
-        out_specs=(P(), P()),
+        out_specs=outs,
         check_vma=False)
     without_rows = shard_map(
         per_shard, mesh=mesh,
         in_specs=tuple(specs),
-        out_specs=(P(), P()),
+        out_specs=outs,
         check_vma=False)
     dn = (0,) if donate else ()
     jitted_rows = jax.jit(with_rows, donate_argnums=dn)
@@ -342,6 +405,7 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                              *args)
         return fn(state, *args)
 
+    step.jitted_fns = (jitted_rows, jitted)
     return step
 
 
@@ -414,4 +478,9 @@ def init_state(model, tx, example_x, example_adjs, key) -> TrainState:
 for _b in (build_train_step, build_e2e_train_step, build_split_train_step):
     if _b.__doc__:
         _b.__doc__ += _DONATED_DOC
+# likewise for the collect_metrics contract (split step: no knob — its
+# stages are driven from the host, where StepStats times them directly)
+for _b in (build_train_step, build_e2e_train_step):
+    if _b.__doc__:
+        _b.__doc__ += _COLLECT_DOC
 del _b

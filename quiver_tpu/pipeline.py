@@ -33,6 +33,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 import weakref
 from concurrent.futures import Future
 from typing import Callable, Iterable, Iterator
@@ -40,35 +41,46 @@ from typing import Callable, Iterable, Iterator
 _STOP = object()
 
 
-def _worker(q: "queue.Queue"):
+def _worker(q: "queue.Queue", stats: dict, lock: "threading.Lock"):
     while True:
         item = q.get()
         if item is _STOP:
             return
-        fut, fn, args, kwargs = item
+        fut, fn, args, kwargs, t_enq = item
         if not fut.set_running_or_notify_cancel():
+            with lock:
+                stats["cancelled"] += 1
             continue                     # cancelled while queued
+        wait = time.perf_counter() - t_enq
         try:
             fut.set_result(fn(*args, **kwargs))
+            ok = True
         except BaseException as e:       # surfaces via fut.result()
             fut.set_exception(e)
+            ok = False
+        with lock:
+            stats["completed" if ok else "failed"] += 1
+            stats["total_wait_s"] += wait
+            stats["max_wait_s"] = max(stats["max_wait_s"], wait)
 
 
-def _drain_cancel(q: "queue.Queue"):
+def _drain_cancel(q: "queue.Queue", stats=None, lock=None):
     while True:
         try:
             item = q.get_nowait()
         except queue.Empty:
             return
-        if item is not _STOP:
-            item[0].cancel()
+        if item is not _STOP and item[0].cancel() and stats is not None:
+            with lock:
+                stats["cancelled"] += 1
 
 
-def _finalize_shutdown(q: "queue.Queue", box: dict):
+def _finalize_shutdown(q: "queue.Queue", box: dict, stats: dict,
+                       lock: "threading.Lock"):
     """GC safety net (must not reference the Pipeline itself): cancel
     queued work and stop the worker so a dropped pipeline leaks no
     thread. No join — this can run from the GC."""
-    _drain_cancel(q)
+    _drain_cancel(q, stats, lock)
     t = box.get("thread")
     if t is not None and t.is_alive():
         q.put(_STOP)
@@ -92,8 +104,17 @@ class Pipeline:
         self._box: dict = {"thread": None}
         self._closed = False
         self._lock = threading.Lock()
+        # telemetry (read via stats()): queue-wait seconds measure how
+        # long staged batches sat behind the worker — the number that
+        # says whether the pipeline depth or the stage itself is the
+        # bottleneck (metrics.StepStats.watch_pipeline consumes this)
+        self._stats = {"submitted": 0, "completed": 0, "failed": 0,
+                       "cancelled": 0, "max_depth": 0,
+                       "total_wait_s": 0.0, "max_wait_s": 0.0}
+        self._stats_lock = threading.Lock()
         self._finalizer = weakref.finalize(self, _finalize_shutdown,
-                                           self._q, self._box)
+                                           self._q, self._box,
+                                           self._stats, self._stats_lock)
 
     # -- core ---------------------------------------------------------------
     def submit(self, fn: Callable, *args, **kwargs) -> Future:
@@ -101,12 +122,22 @@ class Pipeline:
             if self._closed:
                 raise RuntimeError(f"{self._name}: pipeline is closed")
             if self._box["thread"] is None:
-                t = threading.Thread(target=_worker, args=(self._q,),
+                t = threading.Thread(target=_worker,
+                                     args=(self._q, self._stats,
+                                           self._stats_lock),
                                      name=self._name, daemon=True)
                 t.start()
                 self._box["thread"] = t
         fut: Future = Future()
-        self._q.put((fut, fn, args, kwargs))     # blocks at depth
+        # count the submission BEFORE the (possibly blocking) put: a
+        # concurrent stats() read must never see completed > submitted
+        with self._stats_lock:
+            self._stats["submitted"] += 1
+        self._q.put((fut, fn, args, kwargs,
+                     time.perf_counter()))       # blocks at depth
+        with self._stats_lock:
+            self._stats["max_depth"] = max(self._stats["max_depth"],
+                                           self._q.qsize())
         if self._closed:
             # close() raced our enqueue (its drain may have run before
             # our put landed, stranding the item behind _STOP with no
@@ -153,7 +184,7 @@ class Pipeline:
             t = self._box["thread"]
             self._box["thread"] = None
         self._finalizer.detach()
-        _drain_cancel(self._q)
+        _drain_cancel(self._q, self._stats, self._stats_lock)
         if t is not None:
             self._q.put(_STOP)
             # a stage fn / Future done-callback may close the pipeline
@@ -161,6 +192,18 @@ class Pipeline:
             # raise, so skip the join there (the worker exits on _STOP)
             if wait and t is not threading.current_thread():
                 t.join()
+
+    def stats(self) -> dict:
+        """Queue telemetry snapshot: submitted/completed/failed/
+        cancelled counts, peak queued depth, and worker-side wait
+        totals (``mean_wait_s`` derived). Cheap; safe from any
+        thread."""
+        with self._stats_lock:
+            s = dict(self._stats)
+        done = s["completed"] + s["failed"]
+        s["mean_wait_s"] = s["total_wait_s"] / done if done else 0.0
+        s["depth"] = self._q.qsize()
+        return s
 
     @property
     def closed(self) -> bool:

@@ -11,6 +11,7 @@ printed ad hoc (sage_sampler.py:324-348).
 from __future__ import annotations
 
 import contextlib
+import functools
 import time
 from collections import defaultdict
 from typing import Dict
@@ -33,12 +34,17 @@ def trace(log_dir: str):
 
 
 def annotate(name: str):
-    """Decorator form of ``scope`` for hot functions."""
+    """Decorator form of ``scope`` for hot functions.
+
+    ``functools.wraps`` preserves the wrapped function's full identity
+    (signature, docstring, ``__module__``, ``__wrapped__``) — name-only
+    copying broke ``inspect.signature`` on decorated hot functions and
+    made XProf/jaxpr dumps attribute time to anonymous wrappers."""
     def wrap(fn):
+        @functools.wraps(fn)
         def inner(*args, **kwargs):
             with jax.named_scope(name):
                 return fn(*args, **kwargs)
-        inner.__name__ = getattr(fn, "__name__", name)
         return inner
     return wrap
 

@@ -102,7 +102,8 @@ class GraphSageSampler:
                  edge_weight=None, sampling: str = "exact",
                  with_eid: bool = False, layout: str = "pair",
                  shuffle: str = "sort", allow_fallback: bool = True,
-                 wide_exact: bool = True):
+                 wide_exact: bool = True,
+                 collect_metrics: bool = False):
         if mode not in ("HBM", "HOST", "CPU", "UVA", "GPU"):
             raise ValueError(f"unknown sampler mode {mode!r}")
         # accept reference mode names: UVA -> HOST tier, GPU -> HBM
@@ -192,6 +193,14 @@ class GraphSageSampler:
         # (same statistics, k scattered loads per seed) for graphs whose
         # indices already fill most of HBM.
         self.wide_exact = wide_exact
+        # collect_metrics: the jitted sample program also emits the
+        # metrics.NUM_COUNTERS device counter vector (frontier fill vs
+        # the static cap); sample() stashes it on ``self.last_counters``
+        # — a device array, read lazily (StepStats.add_counters) so
+        # sampling stays sync-free. CPU mode has no jitted program and
+        # leaves last_counters as None.
+        self.collect_metrics = bool(collect_metrics)
+        self.last_counters = None
         self._key = jax.random.key(seed)
         self._placed = None
         self._weight_placed = None
@@ -397,26 +406,39 @@ class GraphSageSampler:
                         else "slots")
 
         stride = 128 if self.layout == "overlap" else None
+        collect = self.collect_metrics
 
         def run(indptr, indices, seeds, key, weights=None, rows=None,
                 eid_arr=None, w_rows=None):
             from ..ops.sample_multihop import sample_multihop
             eid = {"none": None, "slots": True, "map": eid_arr}[eid_mode]
-            return sample_multihop(indptr, indices, seeds, sizes, key,
-                                   edge_weight=weights if weighted else None,
-                                   method=method, indices_rows=rows,
-                                   eid=eid,
-                                   indices_stride=stride if rows is not None
-                                   else None,
-                                   weight_rows=w_rows, hub_frac=hub_frac)
+            col = None
+            if collect:
+                from ..metrics import Collector
+                col = Collector()
+            out = sample_multihop(indptr, indices, seeds, sizes, key,
+                                  edge_weight=weights if weighted else None,
+                                  method=method, indices_rows=rows,
+                                  eid=eid,
+                                  indices_stride=stride if rows is not None
+                                  else None,
+                                  weight_rows=w_rows, hub_frac=hub_frac,
+                                  collector=col)
+            if collect:
+                return out + (col.counters(),)
+            return out
 
         return jax.jit(run)
 
     def _fn_for(self, batch_size: int):
-        fn = self._fns.get(batch_size)
+        # keyed on collect_metrics too: the jitted fn's output arity is
+        # baked in at build time, so toggling the knob must not reuse a
+        # cached fn with the other arity
+        key = (batch_size, bool(self.collect_metrics))
+        fn = self._fns.get(key)
         if fn is None:
             fn = self._build_fn(batch_size)
-            self._fns[batch_size] = fn
+            self._fns[key] = fn
         return fn
 
     def next_key(self):
@@ -453,9 +475,13 @@ class GraphSageSampler:
             eid_arr = (jnp.asarray(self.csr_topo.eid)
                        if self.with_eid and self.csr_topo.eid is not None
                        else None)
-        n_id, layers = fn(jnp.asarray(indptr), jnp.asarray(indices),
-                          seeds, self.next_key(), self._weight_placed, rows,
-                          eid_arr, w_rows)
+        out = fn(jnp.asarray(indptr), jnp.asarray(indices),
+                 seeds, self.next_key(), self._weight_placed, rows,
+                 eid_arr, w_rows)
+        if self.collect_metrics:
+            n_id, layers, self.last_counters = out
+        else:
+            n_id, layers = out
         shapes = layer_shapes(bs, self.sizes)
         adjs = []
         for layer, shape in zip(layers, shapes):

@@ -26,6 +26,14 @@ batches (narrow branch) with unique-heavy ones (dense ``lax.cond``
 fallback): both branches live in ONE compiled program, so the
 executable cache must not grow no matter which branch a batch takes.
 
+Phase 5 pins the METRICS path itself: 50 pipelined ``collect=True``
+tiered lookups + donated ``collect_metrics=True`` train steps, every
+counter vector folded through ``metrics.StepStats`` and snapshots
+emitted through a ``MetricsSink`` — the telemetry must add zero new
+executables (its counters are static-shape outputs of the same
+programs), leak no device buffers (StepStats folds lazily but
+bounded), and report zero recompiles via its own watch.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -331,6 +339,82 @@ def main():
         "device buffer leak in the compact-exchange dist loop"
     print("no leak detected (phase 4: pipelined compact-exchange "
           "dist steps)")
+
+    # ---- phase 5: the metrics path leaks nothing either ----
+    import tempfile
+    import time as _time
+
+    from quiver_tpu import metrics as qm
+
+    mstore = qv.Feature(device_cache_size=n // 4 * dim * 4, csr_topo=topo,
+                        dedup_cold=True, cold_budget=256)
+    mstore.from_cpu_tensor(feat)
+    mhost = jnp.asarray(mstore.host_part)
+    stats = qm.StepStats(fold_every=8)
+    sink_path = os.path.join(tempfile.mkdtemp(), "metrics.jsonl")
+    sink = qm.MetricsSink(sink_path)
+
+    def metered_lookup(ids):
+        rows, counters = mstore._lookup_tiered(
+            mstore.device_part, mhost, ids, mstore.feature_order,
+            False, True)
+        jax.block_until_ready(rows)
+        stats.add_counters(counters)
+        return rows
+
+    mstep = build_train_step(model, tx, sizes, bs,
+                             collect_metrics=True)   # donated state
+    mstate = init_state(model, tx, masked_feature_gather(feat_j, n_id),
+                        layers_to_adjs(layers, bs, sizes),
+                        jax.random.key(2))
+
+    def one_metered_step(state, it):
+        seeds = jnp.asarray(rng.integers(0, n, bs, dtype=np.int32))
+        t0 = _time.perf_counter()
+        state, loss, counters = mstep(state, feat_j, None, indptr_j,
+                                      indices_j, seeds, labels[seeds],
+                                      jax.random.key(it))
+        stats.record_step(_time.perf_counter() - t0, counters)
+        return state, loss
+
+    # warmup: compile lookup + step, settle caches, arm the watch
+    for _ in pipelined(metered_lookup, dup_batches(3)):
+        pass
+    mstate, _ = one_metered_step(mstate, 0)
+    stats.watch_compiles(mstore._lookup_tiered, *mstep.jitted_fns)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = mstore._lookup_tiered._cache_size()
+
+    for i, out in enumerate(pipelined(metered_lookup, dup_batches(50))):
+        mstate, mloss = one_metered_step(mstate, 100 + i)
+        if i % 10 == 9:
+            sink.emit_stats(stats)
+    jax.block_until_ready(mloss)
+    del out
+    snap = stats.snapshot()
+    sink.close()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = mstore._lookup_tiered._cache_size() - base_cache
+    print(f"phase 5 live arrays: {base_arrays} -> {arrays}; "
+          f"metered lookup executable-cache growth: {grew}; "
+          f"recompiles seen by StepStats: {snap['recompiles']}")
+    assert grew == 0, "metrics-on lookup recompiled mid-loop"
+    assert snap["recompiles"] == 0, \
+        "metrics-on train step recompiled mid-loop"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak in the metrics path (counter vectors?)"
+    assert snap["steps"] == 51 and snap["counters"]["frontier_cap"] > 0
+    with open(sink_path) as f:
+        lines = [l for l in f if l.strip()]
+    assert len(lines) == 5, f"expected 5 JSONL records, got {len(lines)}"
+    import json as _json
+    rec = _json.loads(lines[-1])
+    assert rec["kind"] == "step_stats" and "counters" in rec
+    mstore.close()
+    print("no leak detected (phase 5: metrics-on pipelined lookups + "
+          "donated metered steps)")
 
 
 if __name__ == "__main__":

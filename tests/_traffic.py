@@ -81,6 +81,32 @@ def tier_read_bytes(fn, args, tier, max_depth=0):
     return total
 
 
+def host_sync_eqns(fn, args,
+                   prims=("io_callback", "pure_callback",
+                          "debug_callback", "python_callback",
+                          "infeed", "outfeed")):
+    """Every host-round-trip equation in the traced program — the
+    structural pin that a jitted path performs ZERO per-step host
+    syncs (the metrics counters must ride out as a plain device
+    output, never via a callback). Returns ``[primitive_name]``;
+    assert it is empty."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+
+    def walk(j):
+        out = []
+        for eqn in j.eqns:
+            if eqn.primitive.name in prims:
+                out.append(eqn.primitive.name)
+            if eqn.primitive.name == "cond":
+                for br in eqn.params["branches"]:
+                    out += walk(br.jaxpr)
+            for sub in _sub_jaxprs(eqn):
+                out += walk(sub)
+        return out
+
+    return walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+
+
 def collective_payloads(fn, args, prims=("all_to_all",),
                         with_depth=False):
     """Every collective equation's payload in the traced program —

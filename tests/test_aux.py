@@ -49,6 +49,60 @@ class TestProfiling:
             return x * 2
         assert int(f(jnp.asarray(3))) == 6
 
+    def test_annotate_preserves_identity(self):
+        import inspect
+
+        @profiling.annotate("hot_fn")
+        def hot(x, k: int = 2):
+            """Doubles, roughly."""
+            return x * k
+
+        # functools.wraps: signature, doc, name, and __wrapped__ all
+        # survive — introspection (and XProf attribution) stay intact
+        assert hot.__name__ == "hot"
+        assert hot.__doc__ == "Doubles, roughly."
+        assert list(inspect.signature(hot).parameters) == ["x", "k"]
+        assert hot.__wrapped__ is not hot
+
+
+class TestDebugLogger:
+    def test_no_duplicate_handlers_on_reconfigure(self):
+        from quiver_tpu import debug
+
+        before = [h for h in debug.logger.handlers
+                  if getattr(h, debug._HANDLER_MARK, False)]
+        assert len(before) == 1           # import attached exactly one
+        debug._configure()                # re-import / forked worker
+        debug._configure()
+        after = [h for h in debug.logger.handlers
+                 if getattr(h, debug._HANDLER_MARK, False)]
+        assert len(after) == 1
+
+    def test_qt_log_level_env(self, monkeypatch):
+        import logging
+
+        from quiver_tpu import debug
+
+        old = debug.logger.level
+        try:
+            monkeypatch.setenv("QT_LOG_LEVEL", "DEBUG")
+            debug._configure(force=True)
+            assert debug.logger.level == logging.DEBUG
+            monkeypatch.setenv("QT_LOG_LEVEL", "15")
+            debug._configure(force=True)
+            assert debug.logger.level == 15
+            # invalid values are ignored, never raise at import
+            monkeypatch.setenv("QT_LOG_LEVEL", "bogus")
+            debug._configure(force=True)
+            assert debug.logger.level == 15
+            # unset + force -> back to NOTSET (defer to the app config;
+            # the library no longer forces INFO on import)
+            monkeypatch.delenv("QT_LOG_LEVEL")
+            debug._configure(force=True)
+            assert debug.logger.level == logging.NOTSET
+        finally:
+            debug.logger.setLevel(old)
+
 
 class TestDebug:
     def test_show_tensor_info(self, capsys):
