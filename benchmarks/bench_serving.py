@@ -1,0 +1,369 @@
+"""Serving load benchmark: requests/s sustained at a p99 latency budget.
+
+The serving layer's two claims, measured with an open-loop Poisson load
+generator (open-loop = arrivals don't wait for completions, so queueing
+delay is REAL — a closed-loop driver would hide it):
+
+1. **Coalescing pays**: a micro-batch server (``batch_cap`` B) sustains
+   >= 5x the requests/s of one-request-per-dispatch serving (the SAME
+   machinery at ``batch_cap=1``) at the SAME p99 budget. "Sustains" =
+   an open-loop trial at that rate completes with zero admission
+   rejects and observed per-request p99 inside the budget.
+2. **Shedding keeps overload bounded**: at 2x the sustained rate, the
+   fanout-ladder + admission-shed server keeps the p99 of ACCEPTED
+   requests bounded (no unbounded queue growth), and the quality cost
+   is measured — argmax agreement of each shed fanout variant against
+   the full-fanout reference on a fixed probe set (the full-vs-full
+   re-run agreement is the sampling-noise floor to read it against).
+
+Also sweeps ``batch_cap`` x ``max_wait_ms`` at a fixed offered load —
+the coalescing-deadline tradeoff surface (bigger batches amortize
+dispatch; longer deadlines add wait the SLO must absorb).
+
+Emits ONE ``BENCH_*``-compatible JSON line on stdout (mirrored to
+``QT_METRICS_JSONL`` with the shared ``{ts, kind, ...}`` schema, kind
+``bench``); an unavailable backend emits ``"skipped": true`` and exits
+0 (the r4/r5 outage convention, same as bench.py).
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/bench_serving.py
+       [--budget-ms F] [--trial-s F] [--smoke]
+Scale knobs (env): QT_SERVE_NODES, QT_SERVE_DIM, QT_SERVE_BATCH_CAP,
+QT_SERVE_TRIAL_S, QT_SERVE_SMOKE=1 (tiny graph + short trials).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from benchmarks._common import configure_jax
+
+METRIC = "served requests/sec at p99 budget (coalesced micro-batch)"
+FULL = [10, 5]
+SHED_LADDER = [[10, 5], [4, 2], [2, 1]]
+
+
+def _record(value=None, err=None, skipped=False, **extra):
+    rec = {"metric": METRIC, "value": value, "unit": "requests/s"}
+    if err is not None:
+        rec["error"] = err
+    if skipped:
+        rec["skipped"] = True
+    rec.update(extra)
+    return rec
+
+
+def _emit(rec):
+    print(json.dumps(rec), flush=True)
+    sink_path = os.environ.get("QT_METRICS_JSONL")
+    if sink_path:
+        from quiver_tpu.metrics import MetricsSink
+        with MetricsSink(sink_path) as sink:
+            sink.emit(rec, kind="bench")
+
+
+def build_world(args, jax):
+    """Synthetic product-shaped serving world: graph + features +
+    inited SAGE params + an engine factory (so the sweep can compile
+    fresh batch_cap configs against the same world)."""
+    import jax.numpy as jnp
+    import optax
+    import quiver_tpu as qv
+    from quiver_tpu.models import GraphSAGE
+    from quiver_tpu.ops import sample_multihop
+    from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                           masked_feature_gather)
+
+    rng = np.random.default_rng(0)
+    n, dim = args.nodes, args.dim
+    deg = rng.poisson(args.avg_deg, n).astype(np.int64).clip(1)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=args.hidden, out_dim=args.classes,
+                      num_layers=2, dropout=0.0)
+    ij = jnp.asarray(indptr.astype(np.int32))
+    xj = jnp.asarray(indices)
+    bs0 = 8
+    n_id, layers = sample_multihop(ij, xj,
+                                   jnp.arange(bs0, dtype=jnp.int32),
+                                   FULL, jax.random.key(0))
+    params = init_state(model, optax.adam(1e-3),
+                        masked_feature_gather(jnp.asarray(feat), n_id),
+                        layers_to_adjs(layers, bs0, FULL),
+                        jax.random.key(1)).params
+    feat_j = jnp.asarray(feat)
+
+    def engine(variants, batch_cap):
+        return qv.ServeEngine(model, params, (ij, xj), feat_j,
+                              sizes_variants=variants,
+                              batch_cap=batch_cap, dedup_gather=True,
+                              collect_metrics=False).warmup()
+
+    return engine, n
+
+
+def open_loop_trial(qv, engine, rate_rps, duration_s, n_nodes, cfg,
+                    seed=0):
+    """Offer Poisson arrivals at ``rate_rps`` for ``duration_s`` against
+    a fresh server over ``engine``; wait for every accepted request.
+    Returns the trial facts (accepted p99, rejects, variant mix...)."""
+    rng = np.random.default_rng(seed)
+    n_arrivals = max(int(rate_rps * duration_s), 1)
+    gaps = rng.exponential(1.0 / rate_rps, n_arrivals)
+    node_ids = rng.integers(0, n_nodes, n_arrivals)
+    server = qv.MicroBatchServer(engine, cfg)
+    futs, rejects = [], 0
+    t0 = time.perf_counter()
+    t_next = t0
+    for k in range(n_arrivals):
+        t_next += gaps[k]
+        delay = t_next - time.perf_counter()
+        # sub-quantum gaps dispatch immediately: time.sleep overshoots
+        # by ~1ms, which would silently cap the OFFERED rate near 1k/s
+        # — batching arrivals onto ms boundaries keeps the offered rate
+        # honest at the cost of <=1.5ms of extra burstiness (arrivals
+        # land early, never late: conservative for the p99 under test)
+        if delay > 0.0015:
+            time.sleep(delay - 0.001)
+        try:
+            futs.append(server.submit(int(node_ids[k])))
+        except qv.OverloadError:
+            rejects += 1
+    t_offered = time.perf_counter() - t0
+    for f in futs:
+        f.result(timeout=120)
+    t_drained = time.perf_counter() - t0
+    snap = server.snapshot()
+    server.close()
+    req = snap.get("request", {})
+    sv = snap["serving"]
+    return {
+        "offered_rps": round(n_arrivals / t_offered, 1),
+        "completed_rps": round(len(futs) / t_drained, 1),
+        "accepted": len(futs),
+        "rejected": rejects,
+        "p50_ms": req.get("p50_ms", 0.0),
+        "p99_ms": req.get("p99_ms", 0.0),
+        "max_ms": req.get("max_ms", 0.0),
+        "batches": sv["batches"],
+        "mean_batch_fill": round(sv["mean_batch_fill"], 2),
+        "variant_batches": sv["variant_batches"],
+        "drain_lag_s": round(t_drained - t_offered, 3),
+    }
+
+
+def find_sustained(qv, engine, budget_ms, n_nodes, cfg, start_rps,
+                   duration_s, max_doublings=10, refine=2, best_of=2):
+    """Rate search: double the offered rate until a trial misses the
+    budget (p99 over, any admission reject, or the backlog outlives
+    the offer window), then bisect ``refine`` times between the last
+    clean and the first failed rate — a raw power-of-two grid would
+    understate a mode that fails marginally just past its capacity.
+    Each rate gets ``best_of`` independent trials and keeps the best
+    p99: this box's scheduler jitter lands 50-100 ms stalls on
+    otherwise-stable trials, and one stall must not misreport a mode's
+    capacity (same machine-noise reasoning as bench_feature's
+    interleaved A/B arms). Returns (sustained_rps, passing_trial,
+    all_trials)."""
+    def trial_at(rate, trials):
+        reps = [open_loop_trial(qv, engine, rate, duration_s, n_nodes,
+                                cfg, seed=len(trials) * best_of + r)
+                for r in range(best_of)]
+        t = min(reps, key=lambda r: (r["rejected"], r["p99_ms"]))
+        t["rate_rps"] = round(rate, 1)
+        t["trials_at_rate"] = best_of
+        t["sustained"] = (
+            t["rejected"] == 0 and t["p99_ms"] <= budget_ms
+            and t["drain_lag_s"] <= max(0.25 * duration_s, 0.2))
+        trials.append(t)
+        return t
+
+    rate = start_rps
+    best, failed = None, None
+    trials = []
+    for _ in range(max_doublings):
+        t = trial_at(rate, trials)
+        if not t["sustained"]:
+            failed = rate
+            break
+        best = t
+        rate *= 2.0
+    lo = best["rate_rps"] if best else 0.0
+    for _ in range(refine if failed else 0):
+        mid = (lo + failed) / 2.0
+        if failed - lo < max(8.0, 0.1 * failed):
+            break
+        t = trial_at(mid, trials)
+        if t["sustained"]:
+            best, lo = t, mid
+        else:
+            failed = mid
+    return (best["completed_rps"] if best else 0.0), best, trials
+
+
+def accuracy_tradeoff(qv, jax, engine, n_nodes, probes=512, reps=2):
+    """Argmax agreement of each fanout variant against the variant-0
+    reference on a fixed probe set (plus variant 0 against itself — the
+    sampling-noise floor). THE quality number shedding trades away."""
+    rng = np.random.default_rng(42)
+    cap = engine.batch_cap
+    ids = rng.integers(0, n_nodes, probes).astype(np.int32)
+
+    def argmaxes(variant):
+        out = []
+        for lo in range(0, probes, cap):
+            chunk = ids[lo:lo + cap]
+            logits = np.asarray(jax.device_get(
+                engine.run(chunk, variant)))[:len(chunk)]
+            out.append(np.argmax(logits, axis=1))
+        return np.concatenate(out)
+
+    ref = argmaxes(0)
+    agree = {}
+    for v in range(len(engine.variants)):
+        vals = [float((argmaxes(v) == ref).mean()) for _ in range(reps)]
+        agree[str(engine.variants[v])] = round(float(np.mean(vals)), 4)
+    return agree
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-ms", type=float, default=100.0,
+                    help="per-request p99 budget both arms must meet "
+                         "(default 100 ms — a recsys-style online SLO; "
+                         "the serial arm is capacity-bound well below "
+                         "any budget past its dispatch latency, so a "
+                         "realistic budget doesn't flatter it)")
+    ap.add_argument("--trial-s", type=float,
+                    default=float(os.environ.get("QT_SERVE_TRIAL_S", 2.0)))
+    ap.add_argument("--smoke", action="store_true",
+                    default=bool(os.environ.get("QT_SERVE_SMOKE")))
+    ap.add_argument("--platform", default=os.environ.get(
+        "QT_BENCH_PLATFORM", ""))
+    args_cli = ap.parse_args()
+
+    if args_cli.platform:
+        os.environ["JAX_PLATFORMS"] = args_cli.platform
+    platform = os.environ.get("JAX_PLATFORMS", "") or "default"
+    if platform not in ("", "cpu", "default"):
+        # non-CPU backends can hang at init (the r4/r5 rounds): reuse
+        # bench.py's out-of-process probe + skip convention
+        from bench import probe_backend
+        ok, detail = probe_backend(args_cli.platform)
+        if not ok:
+            _emit(_record(err=f"backend unavailable: {detail}",
+                          skipped=True, platform=platform))
+            return 0
+
+    jax = configure_jax()
+    import quiver_tpu as qv
+
+    class W:
+        pass
+
+    w = W()
+    if args_cli.smoke:
+        # smallest honest scale: proves the protocol + JSON contract
+        # runs, not a comparable number (sweep dropped, single trials)
+        w.nodes, w.dim, w.hidden, w.classes, w.avg_deg = 5_000, 16, 16, 8, 8
+        batch_cap, trial_s = 16, min(args_cli.trial_s, 0.3)
+        sweep_caps, sweep_waits = [], []
+        best_of, probes, max_doublings = 1, 64, 4
+    else:
+        w.nodes = int(os.environ.get("QT_SERVE_NODES", 50_000))
+        w.dim = int(os.environ.get("QT_SERVE_DIM", 32))
+        w.hidden, w.classes, w.avg_deg = 16, 8, 8
+        batch_cap = int(os.environ.get("QT_SERVE_BATCH_CAP", 32))
+        trial_s = args_cli.trial_s
+        sweep_caps = [8, batch_cap]
+        sweep_waits = [1.0, 4.0]
+        best_of, probes, max_doublings = 2, 512, 10
+    t_start = time.time()
+    engine_of, n_nodes = build_world(w, jax)
+
+    # -- serial baseline: the same server at batch_cap=1 --------------------
+    serial_engine = engine_of([FULL], 1)
+    lat = []
+    for i in range(30):
+        t0 = time.perf_counter()
+        jax.block_until_ready(serial_engine.run(
+            np.array([i % n_nodes], np.int32)))
+        lat.append(time.perf_counter() - t0)
+    serial_dispatch_p50_ms = float(np.percentile(lat, 50) * 1e3)
+    budget_ms = args_cli.budget_ms
+    base_cfg = dict(queue_depth=8192, shed_queue_frac=1.0,
+                    pipeline_depth=2)
+    serial_rps, serial_best, serial_trials = find_sustained(
+        qv, serial_engine, budget_ms, n_nodes,
+        qv.ServeConfig(max_wait_ms=0.0, **base_cfg),
+        start_rps=max(0.25 / np.mean(lat), 8.0), duration_s=trial_s,
+        max_doublings=max_doublings, best_of=best_of)
+
+    # -- coalesced: same budget, same arrivals, batch_cap=B ------------------
+    co_engine = engine_of([FULL], batch_cap)
+    co_cfg = qv.ServeConfig(max_wait_ms=2.0, **base_cfg)
+    co_rps, co_best, co_trials = find_sustained(
+        qv, co_engine, budget_ms, n_nodes, co_cfg,
+        start_rps=max(2.0 * serial_rps, 16.0), duration_s=trial_s,
+        max_doublings=max_doublings, best_of=best_of)
+
+    # -- 2x overload: ladder + admission shed keep p99 bounded ---------------
+    shed_engine = engine_of(SHED_LADDER, batch_cap)
+    overload_rate = 2.0 * max(co_rps, 1.0)
+    shed_cfg = qv.ServeConfig(
+        max_wait_ms=2.0, queue_depth=max(int(budget_ms / 1e3
+                                             * overload_rate), 64),
+        shed_queue_frac=0.25, slo_p99_ms=budget_ms, calm_batches=4)
+    overload = open_loop_trial(qv, shed_engine, overload_rate,
+                               trial_s, n_nodes, shed_cfg, seed=99)
+    overload["rate_rps"] = round(overload_rate, 1)
+    overload["p99_bounded"] = overload["p99_ms"] <= 2.0 * budget_ms
+    agree = accuracy_tradeoff(qv, jax, shed_engine, n_nodes,
+                              probes=probes,
+                              reps=1 if args_cli.smoke else 2)
+
+    # -- batch-size x deadline sweep at half the sustained load --------------
+    sweep = []
+    sweep_rate = max(co_rps / 2.0, 16.0)
+    for cap in sweep_caps:
+        eng = co_engine if cap == batch_cap else engine_of([FULL], cap)
+        for wait_ms in sweep_waits:
+            t = open_loop_trial(
+                qv, eng, sweep_rate, trial_s, n_nodes,
+                qv.ServeConfig(max_wait_ms=wait_ms, **base_cfg),
+                seed=7)
+            sweep.append({"batch_cap": cap, "max_wait_ms": wait_ms,
+                          "rate_rps": round(sweep_rate, 1),
+                          "p50_ms": t["p50_ms"], "p99_ms": t["p99_ms"],
+                          "mean_batch_fill": t["mean_batch_fill"]})
+
+    rec = _record(
+        value=round(co_rps, 1),
+        platform="cpu-smoke" if platform in ("cpu", "default") else platform,
+        p99_budget_ms=round(budget_ms, 2),
+        batch_cap=batch_cap,
+        serial_rps=round(serial_rps, 1),
+        serial_dispatch_p50_ms=round(serial_dispatch_p50_ms, 3),
+        coalesced_vs_serial=(round(co_rps / serial_rps, 2)
+                             if serial_rps else None),
+        coalesced_p99_ms=co_best["p99_ms"] if co_best else None,
+        coalesced_fill=co_best["mean_batch_fill"] if co_best else None,
+        overload=overload,
+        fanout_argmax_agreement=agree,
+        sweep=sweep,
+        trials={"serial": serial_trials, "coalesced": co_trials},
+        elapsed_s=round(time.time() - t_start, 1),
+    )
+    _emit(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

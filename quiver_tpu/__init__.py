@@ -44,7 +44,9 @@ from .inference import layerwise_inference
 from .datasets import GraphDataset, from_numpy_dir
 from .pipeline import Pipeline, pipelined
 from .metrics import Collector, MetricsSink, StepStats
-from . import comm, profiling, checkpoint, datasets, debug, metrics
+from .serving import (MicroBatchServer, OverloadError, ServeConfig,
+                      ServeEngine, build_serve_step)
+from . import comm, profiling, checkpoint, datasets, debug, metrics, serving
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -95,4 +97,9 @@ __all__ = [
     "Collector",
     "MetricsSink",
     "StepStats",
+    "MicroBatchServer",
+    "OverloadError",
+    "ServeConfig",
+    "ServeEngine",
+    "build_serve_step",
 ]

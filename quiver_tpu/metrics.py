@@ -34,9 +34,13 @@ JSONL record schema (one object per line)::
     {"ts": <unix seconds>, "kind": "<record kind>", ...payload}
 
 Record kinds emitted in-tree: ``step_stats`` (StepStats.snapshot()),
-``bench`` (bench.py's measurement record), ``canary``
-(benchmarks/canary.py's usability probe). Consumers key on ``kind``
-and must ignore unknown fields.
+``bench`` (bench.py's and benchmarks/bench_serving.py's measurement
+records), ``canary`` (benchmarks/canary.py's usability probe), and
+``serving`` (``serving.MicroBatchServer.snapshot()`` — a ``step_stats``
+payload whose ``wall`` block times BATCH dispatches, plus a ``request``
+block with per-REQUEST admission->result latency percentiles and a
+``serving`` block with admission/shed/variant-mix counts). Consumers
+key on ``kind`` and must ignore unknown fields.
 """
 
 from __future__ import annotations
@@ -109,6 +113,7 @@ class Collector:
 
     def __init__(self):
         self._entries: List[tuple] = []
+        self._absorbed: List = []
 
     def add(self, slot: int, value) -> None:
         """Accumulate ``value`` into an additive slot."""
@@ -124,7 +129,19 @@ class Collector:
         for slot, val, is_max in self._entries:
             v = jnp.asarray(val).astype(jnp.int32)
             vec = vec.at[slot].max(v) if is_max else vec.at[slot].add(v)
+        for a in self._absorbed:
+            vec = merge_counters(vec, a)
         return vec
+
+    def absorb(self, vec) -> None:
+        """Merge a materialized counter VECTOR (another collector's
+        :meth:`counters` output from the same trace) into this one —
+        how a composite program (e.g. the serving step wrapping a
+        Feature store's self-collecting lookup) folds an inner path's
+        counters into its own without re-instrumenting it. Folded via
+        :func:`merge_counters` at :meth:`counters` time, so the slot
+        semantics (add, max on ``MAX_SLOTS``) live in one place."""
+        self._absorbed.append(jnp.asarray(vec).astype(jnp.int32))
 
 
 def merge_counters(a, b):
@@ -236,6 +253,7 @@ class StepStats:
     def __init__(self, fold_every: int = 64):
         self._fold_every = max(int(fold_every), 1)
         self._hist = _Histogram()
+        self._req_hist = _Histogram()
         self._pending: List = []
         self._counters = np.zeros((NUM_COUNTERS,), np.int64)
         self._steps = 0
@@ -253,6 +271,17 @@ class StepStats:
                 self._pending.append(counters)
                 if len(self._pending) > self._fold_every:
                     self._fold_locked(keep=1)
+
+    def record_request(self, duration_s: float) -> None:
+        """File one PER-REQUEST latency (admission -> result) — the
+        serving layer's unit of account, distinct from the per-step
+        (per-batch) latency ``record_step`` files: a request's latency
+        includes its coalescing wait and any queueing behind in-flight
+        batches, which is exactly what an SLO is written against.
+        Snapshots/reports grow a ``request`` percentile block once any
+        request has been recorded."""
+        with self._lock:
+            self._req_hist.add(duration_s)
 
     def add_counters(self, counters) -> None:
         """File a counter vector not tied to a timed step (e.g. a
@@ -325,6 +354,16 @@ class StepStats:
                 "counters": counters_dict(self._counters),
                 "derived": derive(self._counters),
             }
+            r = self._req_hist
+            if r.n:
+                rec["request"] = {
+                    "count": r.n,
+                    "mean_ms": round(1e3 * r.total / r.n, 3),
+                    "p50_ms": round(1e3 * r.quantile(0.50), 3),
+                    "p95_ms": round(1e3 * r.quantile(0.95), 3),
+                    "p99_ms": round(1e3 * r.quantile(0.99), 3),
+                    "max_ms": round(1e3 * r.max, 3),
+                }
         if self._compile_fns:
             rec["recompiles"] = self._cache_total() - self._compile_base
         if self._pipelines:
@@ -367,6 +406,12 @@ class StepStats:
             f" = {fmt(d['exchange_bucket_peak_frac'], pct=True)} of cap)",
             f"frontier fill: {fmt(d['frontier_fill'], pct=True)}",
         ]
+        if "request" in s:
+            r = s["request"]
+            lines.insert(1, (
+                f"per-request latency ({r['count']} requests): "
+                f"p50 {r['p50_ms']:.2f} ms, p95 {r['p95_ms']:.2f} ms, "
+                f"p99 {r['p99_ms']:.2f} ms, mean {r['mean_ms']:.2f} ms"))
         if "recompiles" in s:
             lines.append(f"recompiles since watch: {s['recompiles']}")
         if "queue" in s:

@@ -1,0 +1,694 @@
+"""Online inference serving: request-coalescing micro-batch server.
+
+The paper's split — sampling is *latency-critical*, feature collection
+is *bandwidth-critical* — was optimized by the training-side PRs for
+throughput. This module is the latency side's consumer: a point-query
+server for GNN inference (recsys/fraud-style "embed/classify THIS
+user now"), where hardware-accelerated sampling only pays off when many
+small requests share one fixed-shape device dispatch.
+
+Three layers, smallest first:
+
+**``build_serve_step``** — one jitted, fixed-shape sample -> gather ->
+forward program per fanout config: ``step(params, key, feat, forder,
+indptr, indices, seeds)`` with ``seeds`` a ``[batch_cap]`` int32 block
+(distinct valid ids first, ``-1`` fill at the tail — the training
+builders' batch contract) returning ``(next_key, logits[batch_cap,
+out_dim])``. The PRNG key is threaded THROUGH the program and its
+buffer is donated, so per-dispatch RNG costs zero host work and zero
+extra allocations; sampling reuses ``ops.sample_multihop``, the gather
+reuses ``masked_feature_gather``/``dedup_feature_gather`` (quantized
+stores compose — pass ``quant.quantize(feat, "int8")``), and the
+forward is the in-tree flax model applied with ``train=False``.
+``collect_metrics=True`` adds the ``metrics.NUM_COUNTERS`` device
+counter vector as a third output (zero host syncs — pinned by
+``tests/_traffic.host_sync_eqns``).
+
+**``ServeEngine``** — owns the model params, the feature tier, the
+topology and a BOUNDED set of pre-compiled fanout variants
+(``sizes_variants``, full quality first, cheaper degradation targets
+after). Every variant shares the ``[batch_cap]`` seed shape, so the
+executable cache holds exactly ``len(sizes_variants)`` serve programs
+for the life of the server (``scripts/check_leak.py`` phase 6 pins
+flatness across mixed-variant traffic). ``warmup()`` compiles them all
+up front — overload is precisely when a compile stall is least
+affordable. A ``Feature`` store plugs in directly: its fused tiered
+lookup (hot HBM rows + cold host rows, ``-1``-mask semantics,
+``dedup_cold`` compaction) runs INSIDE the serve program.
+
+**``MicroBatchServer``** — the async request path. ``submit(node_id)``
+admits one request into a bounded queue and returns a
+``concurrent.futures.Future``; a coalescer thread drains the queue
+into ``[batch_cap]`` batches (duplicate node ids coalesced into the
+SAME batch share one seed slot — the dedup convention applied at the
+request layer; batches already dispatched are not revisited), a max-wait
+deadline bounds how long a lone request can sit waiting for company,
+and a ``pipeline.Pipeline`` executes batches so batch i+1 coalesces
+while batch i runs. Results scatter back to each request's future.
+Latency SLOs are first-class: per-REQUEST admission->result latency
+lands in ``metrics.StepStats`` (``record_request``), and overload
+degrades gracefully in two stages — when queue depth or the observed
+recent p99 crosses the SLO the server *sheds quality* (dispatches a
+smaller pre-compiled fanout variant); when the admission queue is full
+it *sheds load* (``submit`` raises :class:`OverloadError` immediately
+instead of queueing unbounded work). ``snapshot()`` is one
+JSONL-ready record (kind ``serving``).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .parallel.train import (dedup_feature_gather, layers_to_adjs,
+                             masked_feature_gather)
+
+
+class OverloadError(RuntimeError):
+    """Raised by ``MicroBatchServer.submit`` when the admission queue is
+    full — the load-shedding half of overload handling: rejecting at
+    admission is the only response that keeps the latency of the
+    requests already admitted bounded. When raised from
+    ``submit_many``, ``futures`` carries the futures of the requests
+    that WERE admitted before the queue filled (they still run)."""
+
+    futures: Sequence = ()
+
+
+# -- the jitted serve step ---------------------------------------------------
+
+
+def build_serve_step(model, sizes: Sequence[int], batch_cap: int,
+                     method: str = "exact",
+                     dedup_gather=None,
+                     gather: Optional[Callable] = None,
+                     collect_metrics: bool = False):
+    """Pre-compiled point-inference step for one fanout config.
+
+    Returns ``step(params, key, feat, forder, indptr, indices, seeds)``
+    -> ``(next_key, logits)`` (plus the device counter vector with
+    ``collect_metrics=True``). ``seeds`` is ``[batch_cap]`` int32,
+    distinct valid ids first, -1 fill at the tail (the coalescer
+    produces exactly this). Rows of padded slots are garbage — callers
+    index only the valid prefix. The ``key`` argument's buffer is
+    DONATED: the program splits it internally and returns the successor,
+    so the caller threads one key chain through with no per-dispatch
+    host-side RNG work (pass a fresh key only at the start).
+
+    ``feat``/``forder``/topology are arguments, not closures (nothing
+    large bakes into the executable); ``feat`` may be a quantized store.
+    ``dedup_gather`` (True or an int unique budget) swaps the frontier
+    gather for ``dedup_feature_gather``; ``gather`` overrides the whole
+    gather callable (``gather(feat, n_id, forder, collector=None)`` —
+    the ``ServeEngine`` uses this to splice a ``Feature`` store's fused
+    tiered lookup into the program). The returned step exposes
+    ``.jitted_fns`` (for ``StepStats.watch_compiles``) and ``.raw``
+    (the traceable body, for jaxpr pins like ``host_sync_eqns``)."""
+    sizes = list(sizes)
+    if gather is None and dedup_gather is not None:
+        budget = None if dedup_gather is True else int(dedup_gather)
+        gather = (lambda feat, n_id, forder, collector=None:
+                  dedup_feature_gather(feat, n_id, forder, budget,
+                                       collector=collector))
+
+    def forward(params, key, feat, forder, indptr, indices, seeds,
+                collector=None):
+        key, sub = jax.random.split(key)
+        n_id, layers = sample_multihop_serving(
+            indptr, indices, seeds, sizes, sub, method=method,
+            collector=collector)
+        x = (gather or masked_feature_gather)(feat, n_id, forder,
+                                              collector=collector)
+        adjs = layers_to_adjs(layers, batch_cap, sizes)
+        with jax.named_scope("qt_serve_forward"):
+            logits = model.apply(params, x, adjs, train=False)
+        return key, logits[:batch_cap]
+
+    def raw(params, key, feat, forder, indptr, indices, seeds):
+        if not collect_metrics:
+            return forward(params, key, feat, forder, indptr, indices,
+                           seeds)
+        from .metrics import Collector
+        col = Collector()
+        key, logits = forward(params, key, feat, forder, indptr,
+                              indices, seeds, col)
+        return key, logits, col.counters()
+
+    # the key is the one buffer the step both consumes and reproduces —
+    # donating it makes the chain alias in place across dispatches
+    jitted = jax.jit(raw, donate_argnums=(1,))
+    jitted.jitted_fns = (jitted,)
+    jitted.raw = raw
+    return jitted
+
+
+def sample_multihop_serving(indptr, indices, seeds, sizes, key,
+                            method="exact", collector=None):
+    """The serve step's sampling stage — ``ops.sample_multihop`` under
+    the coalescer's batch contract (distinct valid seeds first, -1 tail
+    fill => ``seeds_dense``). Split out so jaxpr pins can trace the
+    sampling half alone."""
+    from .ops.sample_multihop import sample_multihop
+    return sample_multihop(indptr, indices, seeds, sizes, key,
+                           method=method, seeds_dense=True,
+                           collector=collector)
+
+
+# -- the engine: params + tiers + pre-compiled variants ----------------------
+
+
+class ServeEngine:
+    """Pre-compiled fanout-variant set over one model + feature tier.
+
+    ``sizes_variants`` is the BOUNDED degradation ladder: index 0 is
+    full quality, later entries are the cheaper fanouts the server
+    sheds to under pressure (all must have the same hop count — the
+    model's layer count). One executable per variant, all sharing the
+    ``[batch_cap]`` seed shape; nothing else ever compiles, so the
+    executable cache stays flat under any traffic mix.
+
+    ``feat`` is a plain array, a ``quant.QuantizedTensor``, or a
+    ``quiver_tpu.Feature`` store — the store's fused tiered lookup
+    (HBM hot rows + host cold rows, masked, ``dedup_cold``) is spliced
+    into the serve program as its gather stage; stores with a disk/mmap
+    tier are refused (their lookup is host-driven and cannot fuse).
+    ``collect_metrics=True`` makes every ``run`` also emit the device
+    counter vector (stashed on ``last_counters``; read it lazily).
+
+    ``run(seeds, variant=0)`` is NOT thread-safe (the donated key chain
+    is serialized state) — the server funnels all dispatches through
+    its single pipeline worker; direct callers must do the same.
+    """
+
+    def __init__(self, model, params, topo, feat,
+                 sizes_variants: Sequence[Sequence[int]],
+                 batch_cap: int,
+                 forder=None,
+                 method: str = "exact",
+                 dedup_gather=None,
+                 collect_metrics: bool = False,
+                 seed: int = 0):
+        if not sizes_variants:
+            raise ValueError("need at least one fanout variant")
+        hops = {len(s) for s in sizes_variants}
+        if len(hops) != 1:
+            raise ValueError(
+                f"all fanout variants must share the model's hop count, "
+                f"got lengths {sorted(hops)}")
+        self.model = model
+        self.params = params
+        self.variants: List[List[int]] = [list(s) for s in sizes_variants]
+        self.batch_cap = int(batch_cap)
+        self.method = method
+        self.collect_metrics = bool(collect_metrics)
+        self.last_counters = None
+        indptr, indices = (topo.indptr, topo.indices) \
+            if hasattr(topo, "indptr") else topo
+        self._indptr = jnp.asarray(indptr, jnp.int32)
+        self._indices = jnp.asarray(indices, jnp.int32)
+        gather = None
+        if hasattr(feat, "lookup_tiered"):        # a Feature store
+            feat, forder, gather = _feature_gather(feat)
+        elif isinstance(feat, np.ndarray):
+            feat = jnp.asarray(feat)
+        self._feat = feat
+        self._forder = None if forder is None else \
+            jnp.asarray(forder, jnp.int32)
+        self._steps = [
+            build_serve_step(model, sizes, self.batch_cap, method=method,
+                             dedup_gather=dedup_gather, gather=gather,
+                             collect_metrics=self.collect_metrics)
+            for sizes in self.variants]
+        self._key = jax.random.key(seed)
+
+    @property
+    def jitted_fns(self):
+        """Every jitted serve program (one per variant) — feed to
+        ``StepStats.watch_compiles`` so a mid-traffic recompile is a
+        reported incident, not silent latency."""
+        return tuple(f for s in self._steps for f in s.jitted_fns)
+
+    def pad_seeds(self, node_ids) -> np.ndarray:
+        """Host-side batch assembly: distinct valid ids first, -1 fill
+        to ``[batch_cap]`` (the serve step's seed contract)."""
+        ids = np.asarray(node_ids, np.int32).reshape(-1)
+        if ids.shape[0] > self.batch_cap:
+            raise ValueError(
+                f"{ids.shape[0]} seeds exceed batch_cap={self.batch_cap}")
+        out = np.full((self.batch_cap,), -1, np.int32)
+        out[:ids.shape[0]] = ids
+        return out
+
+    def run(self, seeds, variant: int = 0):
+        """Dispatch one ``[batch_cap]`` seed block through the given
+        pre-compiled variant. Returns the ``[batch_cap, out_dim]``
+        logits device array (no host sync — callers ``device_get`` when
+        they scatter). ``seeds`` shorter than ``batch_cap`` are padded
+        here; with ``collect_metrics`` the counter vector lands on
+        ``last_counters``."""
+        seeds = np.asarray(seeds, np.int32)
+        if seeds.shape[0] != self.batch_cap:
+            seeds = self.pad_seeds(seeds)
+        out = self._steps[variant](
+            self.params, self._key, self._feat, self._forder,
+            self._indptr, self._indices, jnp.asarray(seeds))
+        if self.collect_metrics:
+            self._key, logits, self.last_counters = out
+        else:
+            self._key, logits = out
+        return logits
+
+    def warmup(self):
+        """Compile every variant now (one dummy dispatch each) so the
+        first real request — and the first SHED batch, which arrives
+        exactly when the server is drowning — never eats a compile."""
+        for v in range(len(self.variants)):
+            jax.block_until_ready(self.run(
+                np.zeros((self.batch_cap,), np.int32), v))
+        return self
+
+
+def _feature_gather(feature):
+    """Splice a ``Feature`` store's fused tiered lookup into the serve
+    program: returns ``(feat_args, forder, gather)`` where ``feat_args``
+    is the ``(device_part, host_tier)`` pytree the step passes through
+    and ``gather`` runs the store's own traceable lookup body (masked,
+    dedup_cold, quantized tiers — all its conventions) on it."""
+    from .ops import quant
+    if feature.mmap_array is not None:
+        raise ValueError(
+            "ServeEngine cannot fuse a disk/mmap-tier Feature store "
+            "(its cold reads are host-driven); serve from a store whose "
+            "tiers are HBM/host arrays")
+    host = feature._host_offload
+    if host is None and feature.host_part is not None:
+        # numpy cold tier: commit once so the lookup fuses — the serve
+        # path cannot afford a per-batch host round trip. Commit to
+        # PINNED HOST memory (the store's own offload placement), not
+        # device HBM: the cold tier is cold precisely because it does
+        # not fit there. Loud jnp fallback only where host-offload is
+        # unusable (CPU: host and device memory are the same arena).
+        from .utils.placement import pinned_put
+        devs = jax.devices()
+        dev = devs[feature.rank if feature.rank < len(devs) else 0]
+        leaves, tree = jax.tree_util.tree_flatten(feature.host_part)
+        got = pinned_put(leaves, dev, True, "the serving cold tier",
+                         mesh=feature.mesh)
+        if got is not None:
+            host = jax.tree_util.tree_unflatten(tree, got)
+        else:
+            host = quant.tree_map_tier(jnp.asarray, feature.host_part)
+    if host is None:
+        # pure-HBM store: the default masked gather over the cache part
+        # IS the store's lookup (same translate + clip + mask semantics)
+        return feature.device_part, feature.feature_order, None
+    raw = feature._lookup_tiered_raw
+
+    def gather(feat_args, n_id, forder, collector=None):
+        dev, host_t = feat_args
+        if collector is None:
+            return raw(dev, host_t, n_id, forder, True)
+        rows, vec = raw(dev, host_t, n_id, forder, True, True)
+        collector.absorb(vec)
+        return rows
+    return (feature.device_part, host), feature.feature_order, gather
+
+
+# -- the server: admission, coalescing, shedding, scatter --------------------
+
+
+class ServeConfig:
+    """Knobs for :class:`MicroBatchServer` (all latency budgets in ms).
+
+    - ``max_wait_ms``: coalescing deadline — how long the FIRST request
+      of a batch may wait for company before the batch dispatches
+      anyway. The lone-request worst case adds exactly this much.
+    - ``queue_depth``: admission bound; a full queue sheds load
+      (``submit`` raises :class:`OverloadError`).
+    - ``slo_p99_ms``: per-request p99 budget. When the observed p99
+      over the last ``window`` requests exceeds it, the server sheds
+      QUALITY: dispatches escalate one step down the engine's fanout
+      ladder (and recover one step after ``calm_batches`` consecutive
+      in-budget batches).
+    - ``shed_queue_frac``: queue fullness (0..1) that also triggers a
+      quality-shed step — backlog is tomorrow's latency, so the server
+      reacts before the SLO is already blown.
+    - ``pipeline_depth``: in-flight batch bound (coalesce i+1 while i
+      runs; more depth adds queueing latency, not throughput, past 2).
+    """
+
+    def __init__(self, max_wait_ms: float = 2.0, queue_depth: int = 256,
+                 slo_p99_ms: Optional[float] = None,
+                 shed_queue_frac: float = 0.5,
+                 calm_batches: int = 8,
+                 window: int = 256,
+                 pipeline_depth: int = 2):
+        if queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        if not 0.0 < shed_queue_frac <= 1.0:
+            raise ValueError("shed_queue_frac must be in (0, 1]")
+        self.max_wait_ms = float(max_wait_ms)
+        self.queue_depth = int(queue_depth)
+        self.slo_p99_ms = slo_p99_ms
+        self.shed_queue_frac = float(shed_queue_frac)
+        self.calm_batches = int(calm_batches)
+        self.window = int(window)
+        self.pipeline_depth = int(pipeline_depth)
+
+
+class _Request:
+    __slots__ = ("node_id", "future", "t_enq")
+
+    def __init__(self, node_id: int, future, t_enq: float):
+        self.node_id = node_id
+        self.future = future
+        self.t_enq = t_enq
+
+
+class MicroBatchServer:
+    """Request-coalescing micro-batch front end over a ``ServeEngine``.
+
+    ``submit(node_id)`` -> ``Future`` whose result is that node's
+    ``[out_dim]`` numpy logits row (duplicate node ids landing in the
+    same coalesced batch share one seed slot and one device read). Life cycle: ``start()`` spins
+    the coalescer (done by the constructor unless ``start=False`` —
+    tests use the paused form to stage bursts), ``close()`` rejects new
+    work, fails queued requests loudly, and shuts the pipeline down
+    (idempotent; also a context manager). ``snapshot()`` returns the
+    JSONL-ready ``serving`` record; ``emit(sink)`` writes it.
+
+    See :class:`ServeConfig` for the SLO/overload policy and the module
+    docstring for the architecture."""
+
+    def __init__(self, engine: ServeEngine,
+                 config: Optional[ServeConfig] = None,
+                 stats=None, start: bool = True):
+        from .metrics import StepStats
+        from .pipeline import Pipeline
+        self.engine = engine
+        self.config = config or ServeConfig()
+        self.stats = stats if stats is not None else StepStats()
+        self.stats.watch_compiles(*engine.jitted_fns)
+        self._q: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=self.config.queue_depth)
+        self._pipe = Pipeline(depth=self.config.pipeline_depth,
+                              name="quiver-serving-exec")
+        self.stats.watch_pipeline(self._pipe)
+        self._closed = False
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        # shedding state (coalescer-thread only, except the counters)
+        self._shed_level = 0
+        self._calm = 0
+        self._recent = collections.deque(maxlen=self.config.window)
+        self._counts = {
+            "requests": 0, "rejected": 0, "completed": 0, "failed": 0,
+            "batches": 0, "coalesced": 0,
+            "variant_batches": [0] * len(engine.variants),
+        }
+        self._counts_lock = threading.Lock()
+        if start:
+            self.start()
+
+    # -- life cycle ---------------------------------------------------------
+    def start(self) -> "MicroBatchServer":
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("server is closed")
+            if self._thread is None:
+                t = threading.Thread(target=self._coalesce_loop,
+                                     name="quiver-serving-coalescer",
+                                     daemon=True)
+                t.start()
+                self._thread = t
+        return self
+
+    def close(self):
+        """Reject new submissions, fail queued (never-dispatched)
+        requests with ``RuntimeError``, drain the in-flight batches,
+        stop the coalescer and the pipeline. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            t = self._thread
+            self._thread = None
+        if t is not None and t is not threading.current_thread():
+            t.join()
+        # the coalescer is gone: anything still queued will never run
+        undispatched = []
+        while True:
+            try:
+                undispatched.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        self._fail_batch(undispatched)
+        # coalesced batches still QUEUED in the pipeline are cancelled
+        # by its close; their done-callbacks (armed at submit) fail the
+        # request futures — the running batch drains normally first
+        self._pipe.close()
+
+    def __enter__(self) -> "MicroBatchServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, node_id: int):
+        """Admit one point query; returns a ``Future`` resolving to the
+        node's logits row (numpy ``[out_dim]``). Raises
+        :class:`OverloadError` IMMEDIATELY when the admission queue is
+        full — rejecting at the door is the overload policy's last
+        stage (see :class:`ServeConfig`)."""
+        if self._closed:
+            raise RuntimeError("server is closed")
+        from concurrent.futures import Future
+        fut: Future = Future()
+        req = _Request(int(node_id), fut, time.perf_counter())
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            with self._counts_lock:
+                self._counts["rejected"] += 1
+            raise OverloadError(
+                f"admission queue full ({self.config.queue_depth} "
+                "pending); request shed") from None
+        if self._closed:
+            # close() raced us: its drain may have run before our put
+            # landed, and no coalescer will ever pop the request —
+            # reclaim it so the future cannot strand (the claim is
+            # exclusive, so if close's drain got there first this is a
+            # no-op and the future is already failed)
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(RuntimeError("server is closed"))
+            raise RuntimeError("server is closed")
+        with self._counts_lock:
+            self._counts["requests"] += 1
+        return fut
+
+    def submit_many(self, node_ids) -> list:
+        """``submit`` per id. If admission overloads mid-list the
+        raised :class:`OverloadError` carries the already-admitted
+        futures on ``.futures`` — admitted work runs regardless, so its
+        results must stay observable (and a retry must not resubmit
+        them)."""
+        futs: list = []
+        for i in node_ids:
+            try:
+                futs.append(self.submit(i))
+            except OverloadError as e:
+                e.futures = futs
+                raise
+        return futs
+
+    # -- coalescing ---------------------------------------------------------
+    def _coalesce_loop(self):
+        max_wait = self.config.max_wait_ms / 1e3
+        cap = self.engine.batch_cap
+        while not self._closed:
+            try:
+                first = self._q.get(timeout=0.02)
+            except queue.Empty:
+                continue
+            batch = [first]
+            slots = {first.node_id: 0}
+            deadline = time.perf_counter() + max_wait
+            # drain until the seed block is full or the first request's
+            # wait budget is spent — a lone request ships at deadline,
+            # a burst splits into back-to-back full batches
+            while len(slots) < cap:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    req = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(req)
+                slots.setdefault(req.node_id, len(slots))
+            seeds = np.full((cap,), -1, np.int32)
+            for nid, s in slots.items():
+                seeds[s] = nid
+            variant = self._select_variant()
+            # the pipeline submit blocks at depth: device-side
+            # backpressure propagates here, the queue absorbs it, and a
+            # full queue sheds at admission — bounded everywhere
+            try:
+                pf = self._pipe.submit(self._execute, batch, slots,
+                                       seeds, variant)
+            except RuntimeError:
+                if self._closed:       # close() raced the coalescer
+                    self._fail_batch(batch)
+                    return
+                raise
+            # a batch the pipeline cancels while queued (close() drains
+            # it) never reaches _execute — fail its futures, don't
+            # strand them
+            pf.add_done_callback(
+                lambda f, b=batch:
+                    self._fail_batch(b) if f.cancelled() else None)
+
+    # -- shedding policy ----------------------------------------------------
+    def _recent_p99_ms(self) -> Optional[float]:
+        snap = list(self._recent)
+        if len(snap) < 20:            # too few requests to call a p99
+            return None
+        return float(np.percentile(np.asarray(snap), 99.0) * 1e3)
+
+    def _select_variant(self) -> int:
+        """Quality-shed decision for the NEXT batch (coalescer thread
+        only). Escalates one fanout step down the ladder when queue
+        backlog or the recent observed p99 crosses the configured
+        thresholds; recovers one step after ``calm_batches``
+        consecutive calm decisions — hysteresis, so the variant mix
+        doesn't flap (each flap costs nothing in compiles — every
+        variant is pre-compiled — but a stable mix keeps the reported
+        accuracy tradeoff meaningful)."""
+        top = len(self.engine.variants) - 1
+        if top == 0:
+            return 0
+        cfg = self.config
+        shed_at = max(1, int(cfg.queue_depth * cfg.shed_queue_frac))
+        pressed = self._q.qsize() >= shed_at
+        if not pressed and cfg.slo_p99_ms is not None:
+            p99 = self._recent_p99_ms()
+            pressed = p99 is not None and p99 > cfg.slo_p99_ms
+        if pressed:
+            self._shed_level = min(self._shed_level + 1, top)
+            self._calm = 0
+        elif self._shed_level:
+            self._calm += 1
+            if self._calm >= cfg.calm_batches:
+                self._shed_level -= 1
+                self._calm = 0
+        return self._shed_level
+
+    # -- execution + scatter ------------------------------------------------
+    def _fail_batch(self, batch, msg: str = "server closed before "
+                                            "dispatch"):
+        """Fail every not-yet-claimed future in ``batch`` loudly. The
+        claim (``set_running_or_notify_cancel``) is exclusive, so this
+        composes race-free with ``_execute`` and caller-side
+        ``cancel()``."""
+        failed = 0
+        for req in batch:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(RuntimeError(msg))
+                failed += 1
+        if failed:
+            with self._counts_lock:
+                self._counts["failed"] += failed
+
+    def _execute(self, batch, slots, seeds, variant):
+        # claim every request's future up front: a caller-side cancel()
+        # that lands after this point loses the race cleanly (set_result
+        # on a RUNNING future is legal; on a CANCELLED one it raises)
+        batch = [r for r in batch
+                 if r.future.set_running_or_notify_cancel()]
+        if not batch:
+            return
+        t0 = time.perf_counter()
+        try:
+            logits = self.engine.run(seeds, variant)
+            rows = np.asarray(jax.device_get(logits))
+        except BaseException as e:
+            # request-failure propagation: the batch's requests all see
+            # the step's exception; the pipeline records the failure and
+            # stays up for the next batch
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            with self._counts_lock:
+                self._counts["failed"] += len(batch)
+            raise
+        done = time.perf_counter()
+        counters = (self.engine.last_counters
+                    if self.engine.collect_metrics else None)
+        self.stats.record_step(done - t0, counters)
+        # stats and counts land BEFORE the futures resolve: a client
+        # woken by result() may immediately snapshot(), and must see
+        # its own batch counted
+        for req in batch:
+            lat = done - req.t_enq
+            self.stats.record_request(lat)
+            self._recent.append(lat)
+        with self._counts_lock:
+            self._counts["completed"] += len(batch)
+            self._counts["batches"] += 1
+            self._counts["coalesced"] += len(batch)
+            self._counts["variant_batches"][variant] += 1
+        for req in batch:
+            req.future.set_result(rows[slots[req.node_id]])
+
+    # -- observability ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """One JSONL-ready record (kind ``serving``): the underlying
+        ``StepStats`` snapshot (per-request AND per-batch latency
+        percentiles, device counters, recompiles, pipeline queue) plus
+        the serving-layer facts — admission/shed counts, batch fill,
+        per-variant batch mix, current shed level."""
+        rec = self.stats.snapshot()
+        with self._counts_lock:
+            c = dict(self._counts)
+            c["variant_batches"] = list(c["variant_batches"])
+        b = c.pop("batches")
+        coalesced = c.pop("coalesced")
+        rec["serving"] = {
+            **c,
+            "batches": b,
+            "mean_batch_fill": coalesced / b if b else 0.0,
+            "queue_depth": self._q.qsize(),
+            "shed_level": self._shed_level,
+            "fanout_variants": [list(v) for v in self.engine.variants],
+        }
+        return rec
+
+    def emit(self, sink, kind: str = "serving") -> dict:
+        """Append :meth:`snapshot` to a ``metrics.MetricsSink``."""
+        return sink.emit(self.snapshot(), kind=kind)
+
+    def report(self) -> str:
+        """Human-readable one-stop summary."""
+        s = self.snapshot()
+        sv = s["serving"]
+        lines = [self.stats.report()]
+        lines.append(
+            f"serving: {sv['requests']} requests "
+            f"({sv['rejected']} shed at admission, {sv['failed']} "
+            f"failed), {sv['batches']} batches, mean fill "
+            f"{sv['mean_batch_fill']:.1f}/{self.engine.batch_cap}, "
+            f"variant mix {sv['variant_batches']}, shed level "
+            f"{sv['shed_level']}")
+        return "\n".join(lines)

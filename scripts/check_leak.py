@@ -34,6 +34,13 @@ executables (its counters are static-shape outputs of the same
 programs), leak no device buffers (StepStats folds lazily but
 bounded), and report zero recompiles via its own watch.
 
+Phase 6 pins the SERVING layer: 200 point requests driven through the
+request-coalescing micro-batch server in bursts, so queue pressure
+sheds dispatches across the pre-compiled fanout-variant ladder — the
+mixed-variant traffic must grow zero executables/buffers and the
+server's own recompile watch must stay at zero (overload handling
+swaps programs, never compiles one).
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -415,6 +422,63 @@ def main():
     mstore.close()
     print("no leak detected (phase 5: metrics-on pipelined lookups + "
           "donated metered steps)")
+
+    # ---- phase 6: serving — mixed fanout variants, flat executables ----
+    # The serving layer's whole overload story rests on the fanout
+    # ladder being a BOUNDED pre-compiled set: shedding swaps programs,
+    # never compiles one. 200 requests driven through the micro-batch
+    # server in bursts (so queue pressure mixes full and shed variants)
+    # must grow zero executables, zero live buffers, and report zero
+    # recompiles through the server's own StepStats watch.
+    from quiver_tpu.serving import MicroBatchServer, ServeConfig, ServeEngine
+
+    sparams = init_state(model, tx, masked_feature_gather(feat_j, n_id),
+                         layers_to_adjs(layers, bs, sizes),
+                         jax.random.key(3)).params
+    engine = ServeEngine(model, sparams, (indptr_j, indices_j), feat_j,
+                         sizes_variants=[[10, 5], [4, 2], [2, 1]],
+                         batch_cap=64, dedup_gather=True,
+                         collect_metrics=True)
+    engine.warmup()
+    server = MicroBatchServer(engine, ServeConfig(
+        max_wait_ms=1.0, queue_depth=256, shed_queue_frac=0.1,
+        calm_batches=2))
+    # settle: one small wave through every moving part
+    for f in [server.submit(int(i)) for i in rng.integers(0, n, 20)]:
+        f.result(timeout=60)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = sum(f._cache_size() for f in engine.jitted_fns)
+
+    # one 200-request wave: the backlog behind the first [64]-cap batch
+    # crosses the shed threshold (256 * 0.1 = 25 queued), so later
+    # batches MUST take smaller fanout variants while early/settled
+    # ones took the full one — the mixed-variant traffic the phase pins
+    futs = [server.submit(int(i)) for i in rng.integers(0, n, 200)]
+    for f in futs:
+        assert np.isfinite(f.result(timeout=60)).all()
+    served = len(futs)
+    snap = server.snapshot()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = sum(f._cache_size() for f in engine.jitted_fns) - base_cache
+    mix = snap["serving"]["variant_batches"]
+    print(f"phase 6 live arrays: {base_arrays} -> {arrays}; "
+          f"serve executable-cache growth: {grew}; "
+          f"recompiles seen by the server: {snap['recompiles']}; "
+          f"variant mix: {mix}")
+    assert served == 200 and snap["serving"]["failed"] == 0
+    assert sum(1 for b in mix if b) >= 2, \
+        "burst traffic never mixed fanout variants (shed policy dead?)"
+    assert grew == 0, "serving recompiled mid-traffic (variant leak)"
+    assert snap["recompiles"] == 0, \
+        "server's own recompile watch fired mid-traffic"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak across 200 served requests"
+    assert snap["request"]["count"] >= served
+    server.close()
+    print("no leak detected (phase 6: 200 served requests across "
+          "mixed fanout variants)")
 
 
 if __name__ == "__main__":

@@ -115,3 +115,54 @@ def test_bench_unavailable_backend_emits_skipped_record():
     else:
         # a real TPU answered the probe — then the bench must have run
         assert proc.returncode == 0 and out["value"] > 0
+
+
+def test_bench_serving_smoke_json_contract(tmp_path):
+    """The serving load-generator bench (benchmarks/bench_serving.py)
+    keeps its JSON contract CI-tested at smoke scale: one JSON line,
+    the serial-vs-coalesced arms both measured, the 2x-overload record
+    with the shed variant mix, and the fanout/accuracy agreement table
+    — plus the QT_METRICS_JSONL mirror with the shared schema. (The
+    comparable numbers — the >=5x coalescing ratio at the 100 ms p99
+    budget — come from the full-scale run recorded in
+    docs/measurements_r10.md; smoke proves the harness, not the
+    ratio.)"""
+    sink_path = str(tmp_path / "metrics.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "QT_METRICS_JSONL": sink_path,
+        "JAX_PLATFORMS": "cpu",
+        "QT_SERVE_SMOKE": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_serving.py")],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout          # ONE JSON line
+    out = json.loads(lines[0])
+    assert "skipped" not in out and "error" not in out
+    assert out["unit"] == "requests/s"
+    assert out["value"] and out["value"] > 0
+    assert out["serial_rps"] > 0
+    assert out["p99_budget_ms"] > 0
+    # both arms ran at least one open-loop trial against the budget
+    assert out["trials"]["serial"] and out["trials"]["coalesced"]
+    assert out["trials"]["serial"][0]["mean_batch_fill"] == 1.0
+    # the 2x-overload arm reports bounded-latency facts + variant mix
+    ov = out["overload"]
+    assert ov["rate_rps"] > 0 and ov["p99_ms"] > 0
+    assert len(ov["variant_batches"]) == 3       # the shed ladder
+    assert isinstance(ov["p99_bounded"], bool)
+    # accuracy/fanout tradeoff: full fanout vs itself is the noise
+    # floor; every ladder entry reports an agreement fraction
+    agree = out["fanout_argmax_agreement"]
+    assert set(agree) == {"[10, 5]", "[4, 2]", "[2, 1]"}
+    assert all(0.0 <= v <= 1.0 for v in agree.values())
+    # mirrored into the structured metrics log with the shared schema
+    with open(sink_path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    assert len(recs) == 1
+    assert recs[0]["kind"] == "bench"
+    assert recs[0]["value"] == out["value"]

@@ -480,3 +480,73 @@ class TestGatherCollectorPlumbing:
         np.testing.assert_allclose(np.asarray(out),
                                    np.asarray(feat)[np.asarray(ids)],
                                    rtol=1e-6)
+
+
+class TestServingTelemetry:
+    """The ``serving`` record kind's metrics-side half: per-REQUEST
+    latency is a first-class histogram next to the per-step one, the
+    snapshot/report include it only when present, and Collector.absorb
+    folds an inner program's materialized vector with slot semantics
+    (the serve step absorbs the Feature lookup's self-collected
+    counters this way)."""
+
+    def test_record_request_snapshot_and_report(self):
+        stats = qm.StepStats()
+        stats.record_step(0.004)
+        assert "request" not in stats.snapshot()      # nothing filed yet
+        assert "per-request latency" not in stats.report()
+        for ms in (1.0, 2.0, 4.0, 50.0):
+            stats.record_request(ms / 1e3)
+        s = stats.snapshot()
+        assert s["request"]["count"] == 4
+        assert s["request"]["p99_ms"] == pytest.approx(50.0, rel=0.5)
+        assert s["request"]["p50_ms"] < s["request"]["p99_ms"]
+        # per-step wall block is untouched by request recording
+        assert s["steps"] == 1
+        assert "per-request latency (4 requests)" in stats.report()
+
+    def test_serving_kind_jsonl(self, tmp_path):
+        path = str(tmp_path / "serving.jsonl")
+        stats = qm.StepStats()
+        stats.record_request(0.003)
+        rec = dict(stats.snapshot())
+        rec["serving"] = {"requests": 1, "rejected": 0}
+        with qm.MetricsSink(path) as sink:
+            sink.emit(rec, kind="serving")
+            sink.emit_stats(stats)                    # default unchanged
+        with open(path) as f:
+            recs = [json.loads(l) for l in f if l.strip()]
+        assert recs[0]["kind"] == "serving"
+        assert recs[0]["request"]["count"] == 1
+        assert recs[0]["serving"]["requests"] == 1
+        assert recs[1]["kind"] == "step_stats"
+
+    def test_collector_absorb_slot_semantics(self):
+        inner = qm.Collector()
+        inner.add(qm.HOT_ROWS, 5)
+        inner.add(qm.COLD_ROWS, 3)
+        inner.peak(qm.EXCH_CAP, 4)
+        outer = qm.Collector()
+        outer.add(qm.HOT_ROWS, 2)
+        outer.peak(qm.EXCH_CAP, 9)
+        outer.absorb(inner.counters())
+        vec = np.asarray(outer.counters())
+        assert vec[qm.HOT_ROWS] == 7                  # additive
+        assert vec[qm.COLD_ROWS] == 3
+        assert vec[qm.EXCH_CAP] == 9                  # max, not add
+
+    def test_absorb_inside_jit_matches_eager(self):
+        def fn():
+            inner = qm.Collector()
+            inner.add(qm.HOT_ROWS, jnp.int32(11))
+            inner.peak(qm.EXCH_BUCKET_MAX, jnp.int32(6))
+            outer = qm.Collector()
+            outer.peak(qm.EXCH_BUCKET_MAX, jnp.int32(2))
+            outer.absorb(inner.counters())
+            return outer.counters()
+
+        jitted = np.asarray(jax.jit(fn)())
+        eager = np.asarray(fn())
+        np.testing.assert_array_equal(jitted, eager)
+        assert jitted[qm.HOT_ROWS] == 11
+        assert jitted[qm.EXCH_BUCKET_MAX] == 6

@@ -162,6 +162,68 @@ class TestPipeline:
         hf.close()
 
 
+class TestPipelineEdges:
+    """The edges the serving layer leans on (quiver_tpu/serving.py):
+    shutdown = submit-after-close MUST raise (never silently drop or
+    hang a request future), and a worker exception MUST surface through
+    the future while leaving the pipeline serviceable — request-failure
+    propagation without a wedged server."""
+
+    def test_submit_after_close_always_raises(self):
+        p = Pipeline(depth=2, name="quiver-closed-test")
+        p.submit(lambda: 1).result()
+        p.close()
+        for _ in range(3):                 # stays closed, every time
+            with pytest.raises(RuntimeError, match="closed"):
+                p.submit(lambda: 2)
+        # nothing revived the worker
+        assert not any(t.name == "quiver-closed-test" and t.is_alive()
+                       for t in threading.enumerate())
+        assert p.stats()["submitted"] == 1
+
+    def test_submit_on_never_started_closed_pipeline(self):
+        # close before ANY submit: no worker thread ever existed; the
+        # closed contract must hold identically
+        p = Pipeline(depth=1)
+        p.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            p.submit(lambda: 1)
+
+    def test_worker_exception_type_and_traceback_preserved(self):
+        class Custom(ValueError):
+            pass
+
+        def stage():
+            raise Custom("exact failure payload")
+
+        p = Pipeline(depth=2)
+        fut = p.submit(stage)
+        with pytest.raises(Custom, match="exact failure payload"):
+            fut.result(timeout=5)
+        # the failure is telemetry, not a wedge: counted, and the very
+        # next submission runs normally on the same worker
+        assert p.submit(lambda: 41).result(timeout=5) == 41
+        s = p.stats()
+        assert s["failed"] == 1 and s["completed"] == 1
+        p.close()
+
+    def test_interleaved_failures_keep_order_and_isolation(self):
+        def stage(x):
+            if x % 3 == 1:
+                raise RuntimeError(f"item {x} failed")
+            return x * 10
+
+        p = Pipeline(depth=2)
+        futs = [p.submit(stage, i) for i in range(7)]
+        for i, f in enumerate(futs):
+            if i % 3 == 1:
+                with pytest.raises(RuntimeError, match=f"item {i}"):
+                    f.result(timeout=5)
+            else:
+                assert f.result(timeout=5) == i * 10
+        p.close()
+
+
 def _tiny_training(rng, sizes=(3, 2), bs=8, n=120, dim=8, classes=4):
     from quiver_tpu.models import GraphSAGE
     from quiver_tpu.ops import sample_multihop
