@@ -197,6 +197,7 @@ def main():
                                    ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     import jax.numpy as jnp
+    from quiver_tpu import tracing
     from quiver_tpu.ops import (sample_multihop, reshuffle_csr, edge_row_ids,
                                 as_index_rows, as_index_rows_overlapping,
                                 exact_bucket_meta)
@@ -329,7 +330,14 @@ def main():
         total_edges = int(run(indptr, indices, row_ids,
                               jax.random.fold_in(key, 200 + salt),
                               *extra))
-        return total_edges / (time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        # timeline hook (QT_TRACE): the whole timed epoch is ONE device
+        # dispatch, so one span per measured arm is the honest shape
+        tracing.record("bench.epoch", t0, dt,
+                       args={"method": method, "layout": layout,
+                             "shuffle": shuffle, "batches": n_batches,
+                             "edges": total_edges})
+        return total_edges / dt
 
     # metric of record: rotation mode, full epoch (accuracy parity with
     # exact mode for every candidate arm: benchmarks/accuracy_parity.py,
@@ -417,12 +425,17 @@ def main():
             r = store._lookup_tiered(store.device_part, host, a,
                                      store.feature_order)
         jax.block_until_ready(r)
-        rps = f_batch * len(batches_f) / (time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        rps = f_batch * len(batches_f) / dt
+        tracing.record("bench.feature_gather", t0, dt,
+                       args={"batches": len(batches_f),
+                             "rows_per_s": round(rps, 1)})
         # ---- OBSERVED device counters over the same batches (untimed
         # pass): the telemetry the analytic mirrors below only predict —
         # actual hot-tier hit rate and frontier dup factor out of the
         # fused lookup's own classification masks (quiver_tpu.metrics)
         from quiver_tpu import metrics as qmetrics
+        tc0 = time.perf_counter()
         total_c = None
         for a in batches_f:
             _, c = store._lookup_tiered(store.device_part, host, a,
@@ -430,6 +443,10 @@ def main():
             total_c = c if total_c is None else \
                 qmetrics.merge_counters(total_c, c)
         observed = qmetrics.derive(total_c)
+        # the counter pass's span carries the derived ratios — the
+        # observed telemetry lands ON the timeline next to the timed arm
+        tracing.record("bench.observed_counters", tc0,
+                       time.perf_counter() - tc0, args=dict(observed))
         counts = qmetrics.reduce_counters(total_c)
         observed_cold_rows = (counts[qmetrics.COLD_ROWS]
                               / len(batches_f))

@@ -8,15 +8,23 @@
 #
 # Usage: sh benchmarks/chip_suite.sh [section ...]
 #   sections: bench dispatch sampler gather tiered offload e2e exchange
-#             mixed hetero micro ablate
+#             mixed hetero micro ablate regress
 #   default       = every section
 #   quick         = bench only (the metric of record; also warms the
 #                   compile cache for a later full sweep)
 cd "$(dirname "$0")/.."
 LOG=benchmarks/chip_suite.log
+# mirror every bench's measurement record to the shared JSONL history
+# (chip_watch.sh's convention) — the final regress section reads it, so
+# THIS sweep's numbers are part of what the sentinel judges
+QT_METRICS_JSONL=${QT_METRICS_JSONL:-benchmarks/metrics.jsonl}
+export QT_METRICS_JSONL
+# sweep start epoch: the final regress section judges only JSONL
+# records from >= this instant (what THIS sweep measured)
+SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-bench dispatch sampler gather tiered offload e2e exchange mixed hetero micro ablate}"
+SECTIONS="${*:-bench dispatch sampler gather tiered offload e2e exchange mixed hetero micro ablate regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -120,6 +128,15 @@ fi
 # fused-epoch stage ablation (how much of a batch is compaction?)
 if want ablate; then
     step python -u benchmarks/ablate.py
+fi
+
+# regression sentinel, LAST: judge the records THIS sweep mirrored to
+# QT_METRICS_JSONL (--since scopes out stale history lines) against
+# the committed BENCH_r*.json trajectory's best prior non-skipped
+# values; a >15% drop fails the suite loudly (skipped/outage rounds
+# are ignored, never counted as regressions)
+if want regress; then
+    step python -u scripts/bench_regress.py --since "$SUITE_T0"
 fi
 
 date | tee -a "$LOG"

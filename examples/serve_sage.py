@@ -4,11 +4,18 @@ server (docs/serving.md).
 Builds a synthetic graph + tiered feature store + GraphSAGE params,
 pre-compiles a two-step fanout ladder, then plays a short Poisson
 request trace through ``MicroBatchServer`` and prints the serving
-report — per-request p50/p95/p99, batch fill, shed mix. Runs on CPU;
-the same code serves from a TPU host unchanged.
+report — per-request p50/p95/p99, batch fill, shed mix, SLO budget
+burn. Runs on CPU; the same code serves from a TPU host unchanged.
+
+``--trace [PATH]`` additionally records the span timeline
+(``quiver_tpu.tracing``) and exports Perfetto/Chrome trace-event JSON:
+load it at https://ui.perfetto.dev to see each request's admission ->
+coalesce -> dispatch -> scatter path, correlated to the batch that
+carried it via the ``batch``/``trace_id`` span args.
 
 Usage: JAX_PLATFORMS=cpu python examples/serve_sage.py
        [--rate 2000] [--seconds 3] [--batch-cap 32]
+       [--trace [serve_trace.json]]
 """
 
 import argparse
@@ -31,12 +38,18 @@ def main():
                     help="offered requests/s (open-loop Poisson)")
     ap.add_argument("--seconds", type=float, default=3.0)
     ap.add_argument("--slo-p99-ms", type=float, default=50.0)
+    ap.add_argument("--trace", nargs="?", const="serve_trace.json",
+                    default=None, metavar="PATH",
+                    help="record host-side spans and export a "
+                         "Perfetto-loadable trace JSON (default "
+                         "serve_trace.json)")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
     import optax
     import quiver_tpu as qv
+    from quiver_tpu import tracing
     from quiver_tpu.models import GraphSAGE
     from quiver_tpu.ops import sample_multihop
     from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
@@ -80,6 +93,8 @@ def main():
           f"{engine.variants} at batch_cap={args.batch_cap} ...")
     engine.warmup()
 
+    if args.trace:
+        tracing.enable()
     cfg = qv.ServeConfig(max_wait_ms=2.0, queue_depth=1024,
                          slo_p99_ms=args.slo_p99_ms,
                          shed_queue_frac=0.25)
@@ -103,6 +118,11 @@ def main():
               f"admission); first row argmax = {int(rows[0].argmax())}")
         print()
         print(server.report())
+    if args.trace:
+        n = tracing.export_chrome_trace(args.trace)
+        print(f"\nwrote {n} spans to {args.trace} — load it at "
+              "https://ui.perfetto.dev (request<->batch correlation is "
+              "in each span's trace_id/batch args)")
     store.close()
     return 0
 

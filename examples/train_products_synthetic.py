@@ -74,6 +74,13 @@ def main():
                         ".npy files (keys edge_index, feat, labels, "
                         "train_idx[, valid_idx, test_idx] — the standard "
                         "OGB dump, see quiver_tpu.datasets)")
+    p.add_argument("--trace", nargs="?", const="train_trace.json",
+                   default=None, metavar="PATH",
+                   help="record per-step host spans (quiver_tpu.tracing; "
+                        "fully-cached path also collects the device "
+                        "counters, so epoch spans carry the derived "
+                        "hit-rate/dup-factor ratios) and export a "
+                        "Perfetto-loadable trace JSON")
     args = p.parse_args()
 
     # compare parsed values to the parser defaults (argparse-accepted
@@ -90,6 +97,8 @@ def main():
     import jax.numpy as jnp
     import optax
     import quiver_tpu as qv
+    from quiver_tpu import tracing
+    from quiver_tpu.metrics import StepStats
     from quiver_tpu.models import GraphSAGE
     from quiver_tpu.ops import (as_index_rows, as_index_rows_overlapping,
                                 edge_row_ids, reshuffle_csr,
@@ -176,6 +185,16 @@ def main():
                                    method=args.shuffle)
         return as_rows(permuted_j)
 
+    # --trace: host-side span timeline for every step; the fused
+    # builders also thread the device counter vector out
+    # (collect_metrics — zero extra host syncs per step, PR 5's
+    # invariant), so the per-epoch span is annotated with the DERIVED
+    # ratios (hot hit rate, dup factor, frontier fill) via StepStats
+    trace_on = bool(args.trace)
+    if trace_on:
+        tracing.enable()
+    stats = StepStats()
+
     sample_fn = apply_fn = None
     if not fully_cached:
         if mesh:
@@ -188,11 +207,13 @@ def main():
     elif mesh:
         step = build_e2e_train_step(model, tx, sizes, per_dev, mesh,
                                     method=args.sampling,
-                                    indices_stride=stride)
+                                    indices_stride=stride,
+                                    collect_metrics=trace_on)
     else:
         step = build_train_step(model, tx, sizes, per_dev,
                                 method=args.sampling,
-                                indices_stride=stride)
+                                indices_stride=stride,
+                                collect_metrics=trace_on)
 
     rng = np.random.default_rng(0)
     it = 0
@@ -206,14 +227,24 @@ def main():
             for lo in starts:
                 seeds = jnp.asarray(perm[lo:lo + bs].astype(np.int32))
                 y = jnp.asarray(labels[perm[lo:lo + bs]])
+                ts = time.perf_counter()
                 # exact mode: rows is the static un-shuffled view
                 # (wide-fetch exact path; permuted_j == indices_j)
-                state, loss = step(state, feat_j, forder, indptr_j,
-                                   permuted_j, seeds, y,
-                                   jax.random.key(it), rows)
+                out = step(state, feat_j, forder, indptr_j,
+                           permuted_j, seeds, y,
+                           jax.random.key(it), rows)
+                if trace_on:
+                    state, loss, counters = out
+                else:
+                    state, loss = out
                 it += 1
-                epoch_loss += float(loss)
+                epoch_loss += float(loss)   # syncs on the step
                 nb += 1
+                if trace_on:
+                    dt_s = time.perf_counter() - ts
+                    stats.record_step(dt_s, counters)
+                    tracing.record("train.step", ts, dt_s,
+                                   args={"epoch": epoch, "batch": nb - 1})
         elif starts:
             # tiered path, double-buffered: sample batch i+1 and prefetch
             # its feature rows (host-tier staging runs on a background
@@ -230,12 +261,26 @@ def main():
                 adjs, fut, y = nxt
                 if bi + 1 < len(starts):
                     nxt = stage(starts[bi + 1], jax.random.key(it + 1))
+                ts = time.perf_counter() if trace_on else 0.0
                 state, loss = apply_fn(state, fut.result(), adjs, y,
                                        jax.random.key(1000000 + it))
                 it += 1
                 epoch_loss += float(loss)
                 nb += 1
+                if trace_on:
+                    tracing.record("train.step", ts,
+                                   time.perf_counter() - ts,
+                                   args={"epoch": epoch, "batch": bi})
         dt = time.perf_counter() - t0
+        if trace_on:
+            # epoch span annotated with the observed derived ratios
+            # (the PR 5 counters the fused step carried out) — None
+            # entries (path not exercised) dropped for the trace viewer
+            derived = {k: round(v, 4)
+                       for k, v in stats.snapshot()["derived"].items()
+                       if v is not None}
+            tracing.record("train.epoch", t0, dt,
+                           args={"epoch": epoch, "steps": nb, **derived})
         print(f"epoch {epoch}: loss {epoch_loss / max(nb, 1):.4f}  "
               f"{dt:.2f}s  ({nb * bs / dt:.0f} seeds/s)")
 
@@ -289,6 +334,11 @@ def main():
         if tot:
             print(f"test accuracy: {correct / tot:.4f} "
                   f"({tot} labeled test nodes, {ev} batches)")
+
+    if trace_on:
+        n = tracing.export_chrome_trace(args.trace)
+        print(f"wrote {n} spans to {args.trace} — load at "
+              "https://ui.perfetto.dev")
 
 
 if __name__ == "__main__":

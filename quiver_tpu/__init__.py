@@ -43,10 +43,11 @@ from .debug import show_tensor_info
 from .inference import layerwise_inference
 from .datasets import GraphDataset, from_numpy_dir
 from .pipeline import Pipeline, pipelined
-from .metrics import Collector, MetricsSink, StepStats
+from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
                       ServeEngine, build_serve_step)
-from . import comm, profiling, checkpoint, datasets, debug, metrics, serving
+from . import (comm, profiling, checkpoint, datasets, debug, metrics,
+               serving, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -96,6 +97,7 @@ __all__ = [
     "pipelined",
     "Collector",
     "MetricsSink",
+    "SloBudget",
     "StepStats",
     "MicroBatchServer",
     "OverloadError",

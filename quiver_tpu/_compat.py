@@ -10,8 +10,6 @@ installed jax provides it.
 
 from __future__ import annotations
 
-import jax
-
 # NOTE on old-jax GSPMD numerics (documented, deliberately NOT patched
 # here): the GSPMD paths assume value-stable partitioning — random draws
 # and sort/scan results identical regardless of how XLA shards the

@@ -35,16 +35,21 @@ JSONL record schema (one object per line)::
 
 Record kinds emitted in-tree: ``step_stats`` (StepStats.snapshot()),
 ``bench`` (bench.py's and benchmarks/bench_serving.py's measurement
-records), ``canary`` (benchmarks/canary.py's usability probe), and
+records), ``canary`` (benchmarks/canary.py's usability probe),
 ``serving`` (``serving.MicroBatchServer.snapshot()`` — a ``step_stats``
 payload whose ``wall`` block times BATCH dispatches, plus a ``request``
 block with per-REQUEST admission->result latency percentiles and a
-``serving`` block with admission/shed/variant-mix counts). Consumers
-key on ``kind`` and must ignore unknown fields.
+``serving`` block with admission/shed/variant-mix counts), ``slo``
+(:class:`SloBudget.snapshot` — error-budget burn rates), and
+``scope_timer`` (``profiling.ScopeTimer.emit`` — accumulated wall-clock
+stage timings). Consumers key on ``kind`` and must ignore unknown
+fields; ``scripts/lint.sh`` pins that every kind and every counter slot
+has a row in docs/observability.md.
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import math
 import os
@@ -419,6 +424,163 @@ class StepStats:
             lines.append("pipeline: " + ", ".join(
                 f"{k}={round(v, 4)}" for k, v in sorted(q.items())))
         return "\n".join(lines)
+
+
+# -- SLO error-budget accounting --------------------------------------------
+
+
+class SloBudget:
+    """Sliding-window SLO error-budget accounting with multi-window
+    burn rates — the control signal overload policies act on, in place
+    of raw latency samples.
+
+    The SLO reads "over the window, at least ``availability`` of
+    requests complete within ``target_p99_ms``" (the defaults,
+    ``availability=0.99``, make ``target_p99_ms`` a literal p99
+    target). The error BUDGET is the tolerated bad fraction
+    (``1 - availability``); a request is *bad* when it fails or is
+    rejected (``ok=False``) or when its latency exceeds the target.
+    The BURN RATE over a window is ``observed_bad_fraction / budget``:
+    1.0 means spending the budget exactly as fast as the SLO tolerates,
+    above 1.0 burns it faster. Burn rates are computed over TWO windows
+    (``short_window_s`` inside ``window_s``): the short one reacts to
+    pressure *now*, the long one stops a lone spike from flapping the
+    policy — :meth:`should_shed` is the AND of both (the multi-window
+    burn-rate alert shape), which is what ``serving.MicroBatchServer``
+    consults for its quality-shed decision (hysteresis stays the
+    server's, unchanged).
+
+    Bookkeeping is per-second buckets in a bounded deque — O(window
+    seconds) memory regardless of request rate, safe from any thread.
+    :meth:`snapshot` is one JSONL-ready record (kind ``slo``);
+    :meth:`emit` appends it to a :class:`MetricsSink`.
+    """
+
+    def __init__(self, target_p99_ms: float, availability: float = 0.99,
+                 window_s: float = 300.0, short_window_s: float = 30.0,
+                 shed_burn_rate: float = 1.0, min_requests: int = 20,
+                 clock=None):
+        if not 0.0 < availability < 1.0:
+            raise ValueError(
+                f"availability must be in (0, 1), got {availability}")
+        if not 0.0 < short_window_s <= window_s:
+            raise ValueError("need 0 < short_window_s <= window_s")
+        self.target_p99_ms = float(target_p99_ms)
+        self.availability = float(availability)
+        self.budget_frac = 1.0 - self.availability
+        self.window_s = float(window_s)
+        self.short_window_s = float(short_window_s)
+        self.shed_burn_rate = float(shed_burn_rate)
+        self.min_requests = int(min_requests)
+        self._clock = clock if clock is not None else time.monotonic
+        self._buckets: "collections.deque" = collections.deque()
+        self._total = 0
+        self._bad = 0
+        self._lock = threading.Lock()
+
+    # -- recording ----------------------------------------------------------
+    def record(self, latency_s: Optional[float] = None,
+               ok: bool = True) -> None:
+        """File one request outcome: *bad* if it failed/was shed
+        (``ok=False``) or exceeded the latency target."""
+        bad = (not ok) or (latency_s is not None
+                           and latency_s * 1e3 > self.target_p99_ms)
+        sec = int(self._clock())
+        with self._lock:
+            b = self._buckets
+            # a non-monotonic clock read lands in the newest bucket
+            # rather than corrupting the ordering the pruner relies on
+            if b and b[-1][0] >= sec:
+                slot = b[-1]
+            else:
+                slot = [sec, 0, 0]
+                b.append(slot)
+            slot[1] += 1
+            slot[2] += int(bad)
+            self._total += 1
+            self._bad += int(bad)
+            lo = self._clock() - self.window_s - 1.0
+            while b and b[0][0] < lo:
+                b.popleft()
+
+    # -- reading ------------------------------------------------------------
+    def _window_counts(self, seconds: float):
+        lo = self._clock() - seconds
+        total = bad = 0
+        with self._lock:
+            for sec, n, nb in reversed(self._buckets):
+                if sec + 1.0 <= lo:      # bucket wholly before the window
+                    break
+                total += n
+                bad += nb
+        return total, bad
+
+    def burn_rate(self, window_s: Optional[float] = None) -> Optional[float]:
+        """Observed bad-fraction over the window divided by the budget;
+        ``None`` below ``min_requests`` samples (too few to call)."""
+        total, bad = self._window_counts(window_s or self.window_s)
+        return self._rate(total, bad)
+
+    def _rate(self, total, bad) -> Optional[float]:
+        return ((bad / total) / self.budget_frac
+                if total >= self.min_requests else None)
+
+    def budget_remaining(self) -> Optional[float]:
+        """Fraction of the long-window error budget left: 1.0 untouched,
+        0.0 spent exactly, negative overspent; ``None`` below
+        ``min_requests`` (the same too-few-to-call guard as
+        :meth:`burn_rate` — one bad request out of one must not read
+        as a -99x overspend)."""
+        total, bad = self._window_counts(self.window_s)
+        if total < self.min_requests:
+            return None
+        return 1.0 - bad / (self.budget_frac * total)
+
+    def should_shed(self) -> bool:
+        """True while the budget is burning unsustainably: short-window
+        burn above ``shed_burn_rate`` AND long-window burn above 1.0
+        (both with enough samples to mean anything)."""
+        s = self.burn_rate(self.short_window_s)
+        if s is None or s <= self.shed_burn_rate:
+            return False
+        l = self.burn_rate(self.window_s)
+        return l is not None and l > 1.0
+
+    def snapshot(self) -> dict:
+        """One JSONL-ready record (kind ``slo``). Every derived field
+        (burn rates, remaining budget, the shed verdict) is computed
+        from ONE read of each window, so the record is internally
+        consistent even while requests land concurrently."""
+        short_t, short_b = self._window_counts(self.short_window_s)
+        long_t, long_b = self._window_counts(self.window_s)
+        srate = self._rate(short_t, short_b)
+        lrate = self._rate(long_t, long_b)
+        remaining = (1.0 - long_b / (self.budget_frac * long_t)
+                     if long_t >= self.min_requests else None)
+        shedding = (srate is not None and srate > self.shed_burn_rate
+                    and lrate is not None and lrate > 1.0)
+        with self._lock:
+            total, bad = self._total, self._bad
+        return {
+            "target_p99_ms": self.target_p99_ms,
+            "availability": self.availability,
+            "windows": {
+                "short": {"window_s": self.short_window_s,
+                          "requests": short_t, "bad": short_b,
+                          "burn_rate": srate},
+                "long": {"window_s": self.window_s,
+                         "requests": long_t, "bad": long_b,
+                         "burn_rate": lrate},
+            },
+            "budget_remaining": (None if remaining is None
+                                 else round(remaining, 6)),
+            "shedding": shedding,
+            "total": {"requests": total, "bad": bad},
+        }
+
+    def emit(self, sink: "MetricsSink", kind: str = "slo") -> dict:
+        """Append :meth:`snapshot` to a :class:`MetricsSink`."""
+        return sink.emit(self.snapshot(), kind=kind)
 
 
 # -- structured emission ----------------------------------------------------

@@ -38,10 +38,13 @@ import weakref
 from concurrent.futures import Future
 from typing import Callable, Iterable, Iterator
 
+from . import tracing
+
 _STOP = object()
 
 
-def _worker(q: "queue.Queue", stats: dict, lock: "threading.Lock"):
+def _worker(q: "queue.Queue", stats: dict, lock: "threading.Lock",
+            name: str = "pipeline"):
     while True:
         item = q.get()
         if item is _STOP:
@@ -51,13 +54,24 @@ def _worker(q: "queue.Queue", stats: dict, lock: "threading.Lock"):
             with lock:
                 stats["cancelled"] += 1
             continue                     # cancelled while queued
-        wait = time.perf_counter() - t_enq
+        t_run = time.perf_counter()
+        wait = t_run - t_enq
+        # span hooks ride the stats plumbing's own clock reads: when
+        # tracing is off this adds one bool check per item, nothing else
+        traced = tracing.enabled()
+        if traced:
+            tracing.record("pipeline.queue_wait", t_enq, wait,
+                           args={"pipeline": name})
         try:
             fut.set_result(fn(*args, **kwargs))
             ok = True
         except BaseException as e:       # surfaces via fut.result()
             fut.set_exception(e)
             ok = False
+        if traced:
+            tracing.record("pipeline.execute", t_run,
+                           time.perf_counter() - t_run,
+                           args={"pipeline": name, "ok": ok})
         with lock:
             stats["completed" if ok else "failed"] += 1
             stats["total_wait_s"] += wait
@@ -124,7 +138,7 @@ class Pipeline:
             if self._box["thread"] is None:
                 t = threading.Thread(target=_worker,
                                      args=(self._q, self._stats,
-                                           self._stats_lock),
+                                           self._stats_lock, self._name),
                                      name=self._name, daemon=True)
                 t.start()
                 self._box["thread"] = t

@@ -18,6 +18,8 @@ from typing import Dict
 
 import jax
 
+from . import tracing
+
 # named scope: annotates ops for the profiler (the TRACE_SCOPE equivalent)
 scope = jax.named_scope
 
@@ -52,10 +54,17 @@ def annotate(name: str):
 class ScopeTimer:
     """Accumulating wall-clock timer with block-until-ready semantics.
 
+    Every measured block also lands as a ``scope.<name>`` span in
+    ``quiver_tpu.tracing`` when tracing is enabled (same timestamps —
+    the timer's clock reads are reused), so ad-hoc stage timings show
+    up on the same Perfetto timeline as the serving/pipeline spans.
+
     >>> t = ScopeTimer()
     >>> with t.measure("sample"):
     ...     out = sampler.sample(seeds)
-    >>> t.summary()
+    >>> t.summary()                    # printable
+    >>> t.summary_dict()               # JSONL-ready payload
+    >>> t.emit(sink)                   # -> {"kind": "scope_timer", ...}
     """
 
     def __init__(self):
@@ -73,6 +82,7 @@ class ScopeTimer:
             dt = time.perf_counter() - t0
             self.totals[name] += dt
             self.counts[name] += 1
+            tracing.record(f"scope.{name}", t0, dt)
 
     def mean(self, name: str) -> float:
         c = self.counts.get(name, 0)
@@ -83,6 +93,21 @@ class ScopeTimer:
                  f"{self.mean(k) * 1e3:.2f} ms/call x{self.counts[k]}"
                  for k in sorted(self.totals)]
         return "\n".join(lines)
+
+    def summary_dict(self) -> Dict[str, dict]:
+        """The same numbers :meth:`summary` prints, as one JSONL-ready
+        mapping: ``{name: {total_s, calls, mean_ms}}``."""
+        return {k: {"total_s": round(self.totals[k], 6),
+                    "calls": self.counts[k],
+                    "mean_ms": round(self.mean(k) * 1e3, 3)}
+                for k in sorted(self.totals)}
+
+    def emit(self, sink, kind: str = "scope_timer") -> dict:
+        """Append the accumulated timings to a ``metrics.MetricsSink``
+        under the shared ``{ts, kind, ...}`` schema (kind
+        ``scope_timer``) — the structured form of the string
+        :meth:`summary` only printed."""
+        return sink.emit({"scopes": self.summary_dict()}, kind=kind)
 
     def reset(self):
         self.totals.clear()

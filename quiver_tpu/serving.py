@@ -46,18 +46,33 @@ deadline bounds how long a lone request can sit waiting for company,
 and a ``pipeline.Pipeline`` executes batches so batch i+1 coalesces
 while batch i runs. Results scatter back to each request's future.
 Latency SLOs are first-class: per-REQUEST admission->result latency
-lands in ``metrics.StepStats`` (``record_request``), and overload
-degrades gracefully in two stages — when queue depth or the observed
-recent p99 crosses the SLO the server *sheds quality* (dispatches a
-smaller pre-compiled fanout variant); when the admission queue is full
-it *sheds load* (``submit`` raises :class:`OverloadError` immediately
+lands in ``metrics.StepStats`` (``record_request``) and in a
+``metrics.SloBudget`` (target p99 + availability, multi-window
+error-budget burn rates), and overload degrades gracefully in two
+stages — when queue depth crosses its threshold or the SLO budget
+burns unsustainably (``SloBudget.should_shed``: short-window burn
+above ``shed_burn_rate`` AND long-window burn above 1.0 — replacing
+the raw recent-p99 trigger with a signal that also counts failures and
+rejections) the server *sheds quality* (dispatches a smaller
+pre-compiled fanout variant); when the admission queue is full it
+*sheds load* (``submit`` raises :class:`OverloadError` immediately
 instead of queueing unbounded work). ``snapshot()`` is one
-JSONL-ready record (kind ``serving``).
+JSONL-ready record (kind ``serving``, with an ``slo`` block when a
+budget is configured).
+
+With ``quiver_tpu.tracing`` enabled every request leaves a span
+timeline: per-request ``serve.admission_wait`` / ``serve.coalesce_wait``
+/ ``serve.request`` spans (each stamped with its own ``trace_id`` AND
+the ``batch`` id of the coalesced batch that carried it) and per-batch
+``serve.batch_coalesce`` / ``serve.dispatch`` / ``serve.scatter`` spans
+(stamped with the fanout variant) — "where did this request's 100 ms
+go?" becomes one Perfetto click-through. Tracing is host-side only:
+the jitted serve program is bit-identical with tracing on or off
+(pinned in tests/test_serving.py).
 """
 
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 import time
@@ -67,6 +82,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import tracing
 from .parallel.train import (dedup_feature_gather, layers_to_adjs,
                              masked_feature_gather)
 
@@ -332,11 +348,21 @@ class ServeConfig:
       anyway. The lone-request worst case adds exactly this much.
     - ``queue_depth``: admission bound; a full queue sheds load
       (``submit`` raises :class:`OverloadError`).
-    - ``slo_p99_ms``: per-request p99 budget. When the observed p99
-      over the last ``window`` requests exceeds it, the server sheds
-      QUALITY: dispatches escalate one step down the engine's fanout
-      ladder (and recover one step after ``calm_batches`` consecutive
-      in-budget batches).
+    - ``slo_p99_ms``: per-request latency target. Setting it arms a
+      ``metrics.SloBudget`` (target p99 at ``slo_availability`` over
+      sliding windows); the server sheds QUALITY — dispatches escalate
+      one step down the engine's fanout ladder — while the budget burns
+      unsustainably (short-window burn rate above ``shed_burn_rate``
+      AND long-window burn above 1.0), and recovers one step after
+      ``calm_batches`` consecutive calm decisions (hysteresis,
+      unchanged from the old raw-p99 trigger). Failed and
+      admission-rejected requests count against the budget too — the
+      raw p99 never saw them.
+    - ``slo_availability`` / ``slo_window_s`` / ``slo_short_window_s``
+      / ``shed_burn_rate``: the budget's shape — tolerated bad
+      fraction is ``1 - slo_availability`` (default 0.99: a literal
+      p99 target) over ``slo_window_s``, with the reactive burn rate
+      measured over ``slo_short_window_s``.
     - ``shed_queue_frac``: queue fullness (0..1) that also triggers a
       quality-shed step — backlog is tomorrow's latency, so the server
       reacts before the SLO is already blown.
@@ -346,9 +372,12 @@ class ServeConfig:
 
     def __init__(self, max_wait_ms: float = 2.0, queue_depth: int = 256,
                  slo_p99_ms: Optional[float] = None,
+                 slo_availability: float = 0.99,
+                 slo_window_s: float = 300.0,
+                 slo_short_window_s: float = 30.0,
+                 shed_burn_rate: float = 1.0,
                  shed_queue_frac: float = 0.5,
                  calm_batches: int = 8,
-                 window: int = 256,
                  pipeline_depth: int = 2):
         if queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
@@ -357,19 +386,24 @@ class ServeConfig:
         self.max_wait_ms = float(max_wait_ms)
         self.queue_depth = int(queue_depth)
         self.slo_p99_ms = slo_p99_ms
+        self.slo_availability = float(slo_availability)
+        self.slo_window_s = float(slo_window_s)
+        self.slo_short_window_s = float(slo_short_window_s)
+        self.shed_burn_rate = float(shed_burn_rate)
         self.shed_queue_frac = float(shed_queue_frac)
         self.calm_batches = int(calm_batches)
-        self.window = int(window)
         self.pipeline_depth = int(pipeline_depth)
 
 
 class _Request:
-    __slots__ = ("node_id", "future", "t_enq")
+    __slots__ = ("node_id", "future", "t_enq", "trace_id")
 
-    def __init__(self, node_id: int, future, t_enq: float):
+    def __init__(self, node_id: int, future, t_enq: float,
+                 trace_id=None):
         self.node_id = node_id
         self.future = future
         self.t_enq = t_enq
+        self.trace_id = trace_id
 
 
 class MicroBatchServer:
@@ -390,12 +424,23 @@ class MicroBatchServer:
     def __init__(self, engine: ServeEngine,
                  config: Optional[ServeConfig] = None,
                  stats=None, start: bool = True):
-        from .metrics import StepStats
+        from .metrics import SloBudget, StepStats
         from .pipeline import Pipeline
         self.engine = engine
         self.config = config or ServeConfig()
         self.stats = stats if stats is not None else StepStats()
         self.stats.watch_compiles(*engine.jitted_fns)
+        cfg = self.config
+        # the SLO budget is the shed policy's latency signal (burn
+        # rates, not raw p99 samples) AND the `slo` JSONL payload;
+        # public — read it, or `server.slo.emit(sink)` it, any time
+        self.slo: Optional[SloBudget] = None
+        if cfg.slo_p99_ms is not None:
+            self.slo = SloBudget(cfg.slo_p99_ms,
+                                 availability=cfg.slo_availability,
+                                 window_s=cfg.slo_window_s,
+                                 short_window_s=cfg.slo_short_window_s,
+                                 shed_burn_rate=cfg.shed_burn_rate)
         self._q: "queue.Queue[_Request]" = queue.Queue(
             maxsize=self.config.queue_depth)
         self._pipe = Pipeline(depth=self.config.pipeline_depth,
@@ -407,7 +452,6 @@ class MicroBatchServer:
         # shedding state (coalescer-thread only, except the counters)
         self._shed_level = 0
         self._calm = 0
-        self._recent = collections.deque(maxlen=self.config.window)
         self._counts = {
             "requests": 0, "rejected": 0, "completed": 0, "failed": 0,
             "batches": 0, "coalesced": 0,
@@ -476,12 +520,18 @@ class MicroBatchServer:
             raise RuntimeError("server is closed")
         from concurrent.futures import Future
         fut: Future = Future()
-        req = _Request(int(node_id), fut, time.perf_counter())
+        req = _Request(int(node_id), fut, time.perf_counter(),
+                       tracing.new_trace_id() if tracing.enabled()
+                       else None)
         try:
             self._q.put_nowait(req)
         except queue.Full:
             with self._counts_lock:
                 self._counts["rejected"] += 1
+            if self.slo is not None:
+                # a shed request is an availability miss — the budget
+                # must see it (the old raw-p99 trigger never did)
+                self.slo.record(ok=False)
             raise OverloadError(
                 f"admission queue full ({self.config.queue_depth} "
                 "pending); request shed") from None
@@ -522,9 +572,23 @@ class MicroBatchServer:
                 first = self._q.get(timeout=0.02)
             except queue.Empty:
                 continue
+            # span plumbing: one enabled-check per batch when tracing is
+            # off; when on, each request gets admission_wait (queue time
+            # before the coalescer saw it) and coalesce_wait (time spent
+            # waiting for batch company) spans carrying its trace_id +
+            # the batch id — the request<->batch correlation the
+            # Perfetto view pivots on
+            traced = tracing.enabled()
+            bid = tracing.new_trace_id() if traced else None
+            t_first = time.perf_counter()
+            pops = [(first, t_first)]
+            if traced:
+                tracing.record("serve.admission_wait", first.t_enq,
+                               t_first - first.t_enq, first.trace_id,
+                               {"batch": bid, "node": first.node_id})
             batch = [first]
             slots = {first.node_id: 0}
-            deadline = time.perf_counter() + max_wait
+            deadline = t_first + max_wait
             # drain until the seed block is full or the first request's
             # wait budget is spent — a lone request ships at deadline,
             # a burst splits into back-to-back full batches
@@ -538,6 +602,12 @@ class MicroBatchServer:
                     break
                 batch.append(req)
                 slots.setdefault(req.node_id, len(slots))
+                if traced:
+                    t_pop = time.perf_counter()
+                    pops.append((req, t_pop))
+                    tracing.record("serve.admission_wait", req.t_enq,
+                                   t_pop - req.t_enq, req.trace_id,
+                                   {"batch": bid, "node": req.node_id})
             seeds = np.full((cap,), -1, np.int32)
             for nid, s in slots.items():
                 seeds[s] = nid
@@ -547,12 +617,22 @@ class MicroBatchServer:
             # full queue sheds at admission — bounded everywhere
             try:
                 pf = self._pipe.submit(self._execute, batch, slots,
-                                       seeds, variant)
+                                       seeds, variant, bid)
             except RuntimeError:
                 if self._closed:       # close() raced the coalescer
                     self._fail_batch(batch)
                     return
                 raise
+            if traced:
+                t_sub = time.perf_counter()
+                tracing.record("serve.batch_coalesce", t_first,
+                               t_sub - t_first, bid,
+                               {"requests": len(batch),
+                                "fill": len(slots), "variant": variant})
+                for req, t_pop in pops:
+                    tracing.record("serve.coalesce_wait", t_pop,
+                                   t_sub - t_pop, req.trace_id,
+                                   {"batch": bid})
             # a batch the pipeline cancels while queued (close() drains
             # it) never reaches _execute — fail its futures, don't
             # strand them
@@ -561,30 +641,27 @@ class MicroBatchServer:
                     self._fail_batch(b) if f.cancelled() else None)
 
     # -- shedding policy ----------------------------------------------------
-    def _recent_p99_ms(self) -> Optional[float]:
-        snap = list(self._recent)
-        if len(snap) < 20:            # too few requests to call a p99
-            return None
-        return float(np.percentile(np.asarray(snap), 99.0) * 1e3)
-
     def _select_variant(self) -> int:
         """Quality-shed decision for the NEXT batch (coalescer thread
         only). Escalates one fanout step down the ladder when queue
-        backlog or the recent observed p99 crosses the configured
-        thresholds; recovers one step after ``calm_batches``
-        consecutive calm decisions — hysteresis, so the variant mix
-        doesn't flap (each flap costs nothing in compiles — every
-        variant is pre-compiled — but a stable mix keeps the reported
-        accuracy tradeoff meaningful)."""
+        backlog crosses its threshold or the SLO error budget is
+        burning unsustainably (``SloBudget.should_shed`` — the
+        multi-window burn-rate signal that replaced the raw recent-p99
+        trigger; it reacts to the RATE the budget is being spent, and
+        counts failures/rejections the p99 samples never saw); recovers
+        one step after ``calm_batches`` consecutive calm decisions —
+        hysteresis, unchanged, so the variant mix doesn't flap (each
+        flap costs nothing in compiles — every variant is pre-compiled
+        — but a stable mix keeps the reported accuracy tradeoff
+        meaningful)."""
         top = len(self.engine.variants) - 1
         if top == 0:
             return 0
         cfg = self.config
         shed_at = max(1, int(cfg.queue_depth * cfg.shed_queue_frac))
         pressed = self._q.qsize() >= shed_at
-        if not pressed and cfg.slo_p99_ms is not None:
-            p99 = self._recent_p99_ms()
-            pressed = p99 is not None and p99 > cfg.slo_p99_ms
+        if not pressed and self.slo is not None:
+            pressed = self.slo.should_shed()
         if pressed:
             self._shed_level = min(self._shed_level + 1, top)
             self._calm = 0
@@ -608,10 +685,13 @@ class MicroBatchServer:
                 req.future.set_exception(RuntimeError(msg))
                 failed += 1
         if failed:
+            if self.slo is not None:
+                for _ in range(failed):
+                    self.slo.record(ok=False)
             with self._counts_lock:
                 self._counts["failed"] += failed
 
-    def _execute(self, batch, slots, seeds, variant):
+    def _execute(self, batch, slots, seeds, variant, bid=None):
         # claim every request's future up front: a caller-side cancel()
         # that lands after this point loses the race cleanly (set_result
         # on a RUNNING future is legal; on a CANCELLED one it raises)
@@ -630,10 +710,18 @@ class MicroBatchServer:
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(e)
+            if self.slo is not None:
+                for _ in batch:
+                    self.slo.record(ok=False)
             with self._counts_lock:
                 self._counts["failed"] += len(batch)
             raise
         done = time.perf_counter()
+        traced = tracing.enabled() and bid is not None
+        if traced:
+            tracing.record("serve.dispatch", t0, done - t0, bid,
+                           {"variant": variant, "fill": len(slots),
+                            "requests": len(batch)})
         counters = (self.engine.last_counters
                     if self.engine.collect_metrics else None)
         self.stats.record_step(done - t0, counters)
@@ -643,7 +731,8 @@ class MicroBatchServer:
         for req in batch:
             lat = done - req.t_enq
             self.stats.record_request(lat)
-            self._recent.append(lat)
+            if self.slo is not None:
+                self.slo.record(lat)
         with self._counts_lock:
             self._counts["completed"] += len(batch)
             self._counts["batches"] += 1
@@ -651,6 +740,17 @@ class MicroBatchServer:
             self._counts["variant_batches"][variant] += 1
         for req in batch:
             req.future.set_result(rows[slots[req.node_id]])
+        if traced:
+            t_end = time.perf_counter()
+            # scatter = stats filing + future resolution (the wake-up
+            # cost requests pay after the device answer is back)
+            tracing.record("serve.scatter", done, t_end - done, bid,
+                           {"requests": len(batch)})
+            for req in batch:
+                tracing.record("serve.request", req.t_enq,
+                               t_end - req.t_enq, req.trace_id,
+                               {"batch": bid, "node": req.node_id,
+                                "variant": variant})
 
     # -- observability ------------------------------------------------------
     def snapshot(self) -> dict:
@@ -658,8 +758,13 @@ class MicroBatchServer:
         ``StepStats`` snapshot (per-request AND per-batch latency
         percentiles, device counters, recompiles, pipeline queue) plus
         the serving-layer facts — admission/shed counts, batch fill,
-        per-variant batch mix, current shed level."""
+        per-variant batch mix, current shed level — and, when an SLO is
+        configured, the ``SloBudget`` block (burn rates, remaining
+        error budget; also emittable standalone as kind ``slo`` via
+        ``server.slo.emit(sink)``)."""
         rec = self.stats.snapshot()
+        if self.slo is not None:
+            rec["slo"] = self.slo.snapshot()
         with self._counts_lock:
             c = dict(self._counts)
             c["variant_batches"] = list(c["variant_batches"])
@@ -691,4 +796,17 @@ class MicroBatchServer:
             f"{sv['mean_batch_fill']:.1f}/{self.engine.batch_cap}, "
             f"variant mix {sv['variant_batches']}, shed level "
             f"{sv['shed_level']}")
+        if "slo" in s:
+            sl = s["slo"]
+            short = sl["windows"]["short"]["burn_rate"]
+            long_ = sl["windows"]["long"]["burn_rate"]
+            rem = sl["budget_remaining"]
+            fmt = lambda v: "n/a" if v is None else f"{v:.2f}"
+            lines.append(
+                f"slo: p99 target {sl['target_p99_ms']:.1f} ms at "
+                f"{100.0 * sl['availability']:.1f}% — burn rate "
+                f"{fmt(short)} (short) / {fmt(long_)} (long), "
+                f"budget remaining "
+                f"{'n/a' if rem is None else f'{100.0 * rem:.1f}%'}"
+                f"{', SHEDDING' if sl['shedding'] else ''}")
         return "\n".join(lines)

@@ -1,0 +1,281 @@
+"""Host-side span tracing: a lock-cheap ring buffer + Perfetto export.
+
+``metrics.py`` (PR 5) answers "how much / how often" with counters and
+histograms; this module answers "where did this request's 100 ms go?"
+with a TIMELINE. It is the host-side half of the observability story —
+the device half stays ``jax.profiler`` / XProf named scopes — and the
+observation layer the ROADMAP item 4 controller reads: overlap problems
+(cold-tier prefetch behind compute, coalesce wait vs dispatch) are
+invisible in percentiles but obvious in a trace.
+
+Design constraints, in order:
+
+1. **Zero cost when off.** Tracing is opt-in (``QT_TRACE=1`` /
+   ``QT_TRACE=/path/out.json`` / :func:`enable`); disabled, every hook
+   is one attribute check (``record``) or a shared no-op context
+   manager (``span``) — the instrumented hot paths (the serving
+   coalescer, the pipeline worker) reuse timestamps they already take
+   for ``stats()``, so no extra clock reads either.
+2. **Lock-cheap when on.** Records land in a fixed-capacity ring
+   buffer: one atomic ``next(itertools.count())`` for the slot, one
+   list-item store for the record (both single bytecode effects under
+   the GIL — no lock, no allocation beyond the record tuple). When the
+   ring wraps, the oldest spans are overwritten: a long-running server
+   keeps the RECENT window, bounded memory by construction
+   (``scripts/check_leak.py`` phase 7 pins this).
+3. **Never inside jit.** Spans time HOST work around device dispatches;
+   nothing here touches a traced program, so the PR 5 invariants (zero
+   per-step host syncs, bit-identical outputs with tracing on/off,
+   donation intact) hold trivially — and are still pinned explicitly in
+   ``tests/test_serving.py``.
+
+A span record is ``(name, tid, t0, dur, trace_id, args)``: ``t0``/
+``dur`` in ``time.perf_counter()`` seconds, ``tid`` the recording
+thread, ``trace_id`` an optional correlation id (the serving layer
+gives every request one and stamps each request span with the id of
+the BATCH that carried it, so a request's admission -> coalesce ->
+dispatch -> scatter path is one click-through in the viewer), ``args``
+a small JSON-able dict.
+
+:func:`export_chrome_trace` writes the Chrome trace-event JSON the
+Perfetto UI (https://ui.perfetto.dev) and ``chrome://tracing`` load
+directly: complete (``"ph": "X"``) events on named thread tracks, span
+``args`` (including ``trace_id``) visible in the selection panel.
+
+Usage::
+
+    from quiver_tpu import tracing
+    tracing.enable()
+    with tracing.span("stage.load", args={"rows": 4096}):
+        ...
+    tracing.export_chrome_trace("/tmp/trace.json")   # -> Perfetto
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+Record = Tuple[str, int, float, float, Optional[int], Optional[dict]]
+
+DEFAULT_CAPACITY = 65536
+
+
+class _NullSpan:
+    """The shared do-nothing context manager handed out while tracing
+    is disabled — no per-call allocation on the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "trace_id", "args", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 trace_id: Optional[int], args: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.args = args
+
+    def __enter__(self) -> "_Span":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tracer.record(self.name, self.t0,
+                            time.perf_counter() - self.t0,
+                            self.trace_id, self.args)
+
+
+class Tracer:
+    """Fixed-capacity span ring buffer (see module doc for the
+    concurrency argument). One process-wide instance normally suffices
+    (:func:`get_tracer`); independent tracers compose for tests."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: List[Optional[Record]] = [None] * self.capacity
+        self._seq = itertools.count()
+        self._ids = itertools.count(1)
+        self._tid_names: Dict[int, str] = {}
+        self._enabled = False
+
+    # -- switch -------------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, capacity: Optional[int] = None) -> "Tracer":
+        """Turn recording on (optionally resizing — a resize discards
+        already-recorded spans)."""
+        if capacity is not None and int(capacity) != self.capacity:
+            if capacity < 1:
+                raise ValueError(f"capacity must be >= 1, got {capacity}")
+            self.capacity = int(capacity)
+            self.clear()
+        self._enabled = True
+        return self
+
+    def disable(self) -> "Tracer":
+        self._enabled = False
+        return self
+
+    def clear(self) -> None:
+        """Drop every recorded span (the ring survives, emptied)."""
+        # swap ring and sequence together; record() indexes a LOCAL ref
+        # of the ring by its own length, so a racing writer lands its
+        # record in whichever ring it grabbed, never out of bounds. A
+        # racing writer may register its thread name into the old dict
+        # (lost) — its spans still export, just without the name row.
+        self._ring = [None] * self.capacity
+        self._seq = itertools.count()
+        self._tid_names = {}
+
+    # -- recording ----------------------------------------------------------
+    def new_trace_id(self) -> int:
+        """A fresh correlation id (process-unique, monotonic)."""
+        return next(self._ids)
+
+    def record(self, name: str, t0: float, dur: float,
+               trace_id: Optional[int] = None,
+               args: Optional[dict] = None) -> None:
+        """File one completed span from timestamps the caller already
+        holds (``t0`` from ``time.perf_counter()``, ``dur`` seconds) —
+        the zero-extra-clock-read form the hot paths use."""
+        if not self._enabled:
+            return
+        tid = threading.get_ident()
+        if tid not in self._tid_names:
+            self._tid_names[tid] = threading.current_thread().name
+        ring = self._ring
+        ring[next(self._seq) % len(ring)] = (
+            name, tid, t0, dur, trace_id, args)
+
+    def span(self, name: str, trace_id: Optional[int] = None,
+             args: Optional[dict] = None):
+        """Context manager timing its block into one record; the shared
+        no-op instance when disabled."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name, trace_id, args)
+
+    # -- reading / export ---------------------------------------------------
+    def __len__(self) -> int:
+        return sum(1 for r in self._ring if r is not None)
+
+    def records(self) -> List[Record]:
+        """Chronological snapshot of the retained spans (<= capacity;
+        the ring keeps the most recent ones once wrapped)."""
+        recs = [r for r in self._ring if r is not None]
+        recs.sort(key=lambda r: r[2])
+        return recs
+
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the retained spans as Chrome trace-event JSON (the
+        format Perfetto / ``chrome://tracing`` load). Returns the number
+        of span events written. Timestamps are ``perf_counter``-relative
+        microseconds — offsets within the trace are what matter."""
+        pid = os.getpid()
+        # copy before iterating: recorder threads (pipeline workers, a
+        # live coalescer) may register a first-seen tid mid-export —
+        # iterating the live dict would raise and lose the whole trace
+        events: List[dict] = [
+            {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+             "args": {"name": tname}}
+            for tid, tname in sorted(self._tid_names.copy().items())]
+        recs = self.records()
+        for name, tid, t0, dur, trace_id, args in recs:
+            ev = {"ph": "X", "pid": pid, "tid": tid, "name": name,
+                  "cat": name.split(".", 1)[0],
+                  "ts": round(t0 * 1e6, 3),
+                  "dur": round(max(dur, 0.0) * 1e6, 3)}
+            a = dict(args) if args else {}
+            if trace_id is not None:
+                a["trace_id"] = trace_id
+            if a:
+                ev["args"] = a
+            events.append(ev)
+        with open(path, "w") as f:
+            # default=str: span args may carry numpy scalars etc.; a
+            # lossy string beats a failed export
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                      f, default=str)
+        return len(recs)
+
+
+# -- the process-default tracer ---------------------------------------------
+
+_tracer = Tracer(int(os.environ.get("QT_TRACE_CAPACITY",
+                                    str(DEFAULT_CAPACITY))))
+
+
+def get_tracer() -> Tracer:
+    """The process-default :class:`Tracer` every in-tree hook records
+    into."""
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer._enabled
+
+
+def enable(capacity: Optional[int] = None) -> Tracer:
+    return _tracer.enable(capacity)
+
+
+def disable() -> Tracer:
+    return _tracer.disable()
+
+
+def clear() -> None:
+    _tracer.clear()
+
+
+def new_trace_id() -> int:
+    return _tracer.new_trace_id()
+
+
+def record(name: str, t0: float, dur: float,
+           trace_id: Optional[int] = None,
+           args: Optional[dict] = None) -> None:
+    _tracer.record(name, t0, dur, trace_id, args)
+
+
+def span(name: str, trace_id: Optional[int] = None,
+         args: Optional[dict] = None):
+    return _tracer.span(name, trace_id, args)
+
+
+def records() -> List[Record]:
+    return _tracer.records()
+
+
+def export_chrome_trace(path: str) -> int:
+    return _tracer.export_chrome_trace(path)
+
+
+# QT_TRACE=1 turns recording on; QT_TRACE=<path> additionally exports
+# the ring to <path> at interpreter exit (the no-code-changes workflow:
+# QT_TRACE=/tmp/trace.json python examples/serve_sage.py)
+_env = os.environ.get("QT_TRACE", "")
+if _env and _env.lower() not in ("0", "false", "no", "off"):
+    _tracer.enable()
+    if _env.lower() not in ("1", "true", "yes", "on"):
+        atexit.register(_tracer.export_chrome_trace, _env)

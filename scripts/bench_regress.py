@@ -1,0 +1,192 @@
+"""Bench regression sentinel: fail on a >15% drop vs the best prior run.
+
+Reads the committed ``BENCH_r*.json`` round trajectory (driver records:
+``{"n", "cmd", "rc", "tail"}`` where ``tail`` holds the bench's one
+JSON measurement line) plus, when present, a ``QT_METRICS_JSONL``
+history (``{"ts", "kind": "bench", ...}`` records from ``bench.py`` /
+``benchmarks/bench_serving.py``), and walks each metric's values in
+round order:
+
+- records with ``"skipped": true`` or ``value: null`` are SKIPPED, not
+  failed — the r03-r05 rounds were TPU-infra-unavailable, which is an
+  outage, not a regression (``bench.py`` emits the distinguishable
+  skip record for exactly this consumer);
+- values are grouped by ``(metric, platform)`` so a ``cpu-smoke`` run
+  is never compared against a TPU number;
+- the verdict judges each group's LATEST non-skipped value against the
+  best prior one: more than ``--threshold`` (default 15%) below it is
+  a regression — reported and exit code 1 (``chip_suite.sh`` exports
+  ``QT_METRICS_JSONL`` and runs this as its final section, so the
+  sweep that just ran is the latest record and a silent slowdown
+  fails loudly). Only the latest is judged: a real regression is
+  still low *now*, while an old dip that has since recovered is
+  yesterday's news, not a reason to fail today's sweep forever.
+
+The JSONL history is append-only and outlives committed rounds, and
+its records sort AFTER the whole committed trajectory here (its ``ts``
+and the rounds' ``n`` share no clock) — so a stale history line would
+otherwise masquerade as "the latest value" forever, even once a
+committed improvement supersedes it. ``--since EPOCH`` scopes the
+JSONL to records with ``ts >= EPOCH``: ``chip_suite.sh`` captures its
+start time and passes it, so the final regress section judges exactly
+what this sweep measured, against everything before it.
+
+Values are rates (edges/s, requests/s, rows/s) — higher is better.
+
+Stdlib only (no jax import): the sentinel must run instantly anywhere,
+including as the last step of an on-chip sweep and inside tier-1 tests.
+
+Usage: python scripts/bench_regress.py [--threshold 0.15]
+           [--bench-dir DIR] [--jsonl PATH] [--since EPOCH]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def parse_tail_records(tail):
+    """Every JSON measurement object embedded in a driver record's
+    captured ``tail`` (one per line; traceback noise ignored)."""
+    out = []
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            out.append(rec)
+    return out
+
+
+def load_trajectory(bench_dir):
+    """``[(label, record)]`` in round order from BENCH_r*.json files."""
+    runs = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                run = json.load(f)
+        except ValueError as e:
+            print(f"WARN {os.path.basename(path)}: unreadable ({e})")
+            continue
+        runs.append((run.get("n", 0), os.path.basename(path), run))
+    runs.sort(key=lambda r: (r[0], r[1]))
+    out = []
+    for _, name, run in runs:
+        for rec in parse_tail_records(run.get("tail", "")):
+            out.append((name, rec))
+    return out
+
+
+def load_jsonl(path, since=None):
+    """``[(label, record)]`` from a shared-schema metrics JSONL file —
+    only ``kind: bench`` measurement records (other kinds — step_stats,
+    serving, slo, canary... — are not trajectory points), and only
+    those with ``ts >= since`` when a scope is given."""
+    out = []
+    if not path or not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") != "bench" or "metric" not in rec:
+                continue
+            if since is not None and rec.get("ts", 0) < since:
+                continue
+            out.append((f"{os.path.basename(path)}:{i + 1}", rec))
+    return out
+
+
+def is_skipped(rec):
+    """The outage convention: an explicitly skipped round, or one that
+    produced no number at all, is not evidence of a regression."""
+    return bool(rec.get("skipped")) or rec.get("value") is None
+
+
+def check(records, threshold):
+    """Walk ``[(label, rec)]`` in order; judge each group's LATEST
+    value against the best PRIOR one. Returns (regressions, checked)
+    where each regression is a dict naming the drop."""
+    best = {}          # (metric, platform) -> (value, label)
+    latest = {}        # (metric, platform) -> (value, label)
+    checked = 0
+    for label, rec in records:
+        if is_skipped(rec):
+            continue
+        value = rec.get("value")
+        if not isinstance(value, (int, float)):
+            continue
+        key = (rec.get("metric", "?"), rec.get("platform", ""))
+        checked += 1
+        prev = latest.get(key)
+        if prev is not None:
+            prior = best.get(key)
+            if prior is None or prev[0] > prior[0]:
+                best[key] = prev
+        latest[key] = (value, label)
+    regressions = []
+    for key, (value, label) in sorted(latest.items()):
+        prior = best.get(key)
+        if prior is not None and value < (1.0 - threshold) * prior[0]:
+            regressions.append({
+                "metric": key[0], "platform": key[1] or "default",
+                "value": value, "best": prior[0],
+                "best_run": prior[1], "run": label,
+                "drop_frac": 1.0 - value / prior[0],
+            })
+    return regressions, checked
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="max tolerated fractional drop vs the best "
+                         "prior value (default 0.15)")
+    ap.add_argument("--bench-dir",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding BENCH_r*.json")
+    ap.add_argument("--jsonl", default=os.environ.get("QT_METRICS_JSONL"),
+                    help="metrics JSONL history to append to the "
+                         "trajectory (default: $QT_METRICS_JSONL)")
+    ap.add_argument("--since", type=float, default=None, metavar="EPOCH",
+                    help="only include JSONL records with ts >= EPOCH "
+                         "(chip_suite.sh passes its start time so the "
+                         "verdict judges this sweep's records, not "
+                         "stale history)")
+    args = ap.parse_args(argv)
+
+    records = (load_trajectory(args.bench_dir)
+               + load_jsonl(args.jsonl, args.since))
+    if not records:
+        print(f"bench_regress: no bench records under {args.bench_dir}; "
+              "nothing to check")
+        return 0
+    skipped = sum(1 for _, r in records if is_skipped(r))
+    regressions, checked = check(records, args.threshold)
+    print(f"bench_regress: {checked} measured records "
+          f"({skipped} skipped/unavailable rounds ignored), "
+          f"threshold {args.threshold:.0%}")
+    for r in regressions:
+        print(f"REGRESSION {r['metric']} [{r['platform']}]: "
+              f"{r['value']:.1f} in {r['run']} is {r['drop_frac']:.1%} "
+              f"below best {r['best']:.1f} ({r['best_run']})")
+    if regressions:
+        return 1
+    print("bench_regress: trajectory clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

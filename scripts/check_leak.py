@@ -41,6 +41,14 @@ mixed-variant traffic must grow zero executables/buffers and the
 server's own recompile watch must stay at zero (overload handling
 swaps programs, never compiles one).
 
+Phase 7 pins the TRACING path: 100 served requests with span tracing
+AND metrics AND the SLO budget all on. Tracing is host-side only, so
+it must add zero executables and zero recompiles; the span ring buffer
+is fixed-capacity by construction — the phase runs with a ring smaller
+than the span volume so the wrap actually happens, and asserts the
+retained span count never exceeds capacity (bounded memory no matter
+how long the server runs) and that the Perfetto export round-trips.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -479,6 +487,56 @@ def main():
     server.close()
     print("no leak detected (phase 6: 200 served requests across "
           "mixed fanout variants)")
+
+    # ---- phase 7: traced+metered serving — spans on, still flat ----
+    # The tracer is host-side: spans must cost zero executables and
+    # zero recompiles, and the ring must stay within its capacity (the
+    # ring is sized BELOW the span volume here so the wraparound path
+    # is what gets pinned, not the easy prefix).
+    from quiver_tpu import tracing
+
+    ring_cap = 256      # < the ~400-span volume below => the ring WRAPS
+    tracing.enable(capacity=ring_cap)
+    server = MicroBatchServer(engine, ServeConfig(
+        max_wait_ms=1.0, queue_depth=256, shed_queue_frac=0.1,
+        slo_p99_ms=50.0, calm_batches=2))
+    # settle (same discipline as phase 6), with tracing already on
+    for f in [server.submit(int(i)) for i in rng.integers(0, n, 20)]:
+        f.result(timeout=60)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = sum(f._cache_size() for f in engine.jitted_fns)
+
+    futs = [server.submit(int(i)) for i in rng.integers(0, n, 100)]
+    for f in futs:
+        assert np.isfinite(f.result(timeout=60)).all()
+    snap = server.snapshot()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = sum(f._cache_size() for f in engine.jitted_fns) - base_cache
+    nspans = len(tracing.get_tracer())
+    print(f"phase 7 live arrays: {base_arrays} -> {arrays}; "
+          f"traced-serve executable-cache growth: {grew}; "
+          f"spans retained: {nspans}/{ring_cap}")
+    assert grew == 0, "tracing grew the executable cache (it is "  \
+        "host-side only and must not touch the jitted programs)"
+    assert snap["recompiles"] == 0, "recompile under traced serving"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak across traced serving requests"
+    assert nspans == ring_cap, \
+        "span ring did not wrap at its fixed capacity (phase premise: " \
+        "span volume must exceed the ring)"
+    assert snap["slo"]["total"]["requests"] >= 100
+    trace_path = os.path.join(tempfile.mkdtemp(), "trace.json")
+    exported = tracing.export_chrome_trace(trace_path)
+    with open(trace_path) as fh:
+        doc = _json.load(fh)
+    assert exported == nspans and len(doc["traceEvents"]) >= exported
+    server.close()
+    tracing.disable()
+    tracing.clear()
+    print("no leak detected (phase 7: traced+metered serving, bounded "
+          "span ring)")
 
 
 if __name__ == "__main__":

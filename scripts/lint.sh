@@ -6,6 +6,11 @@
 #   - every python source byte-compiles (syntax)
 #   - no tabs/indentation ambiguity (tabnanny)
 #   - unused imports (AST walk)
+#   - observability contract drift: every metrics.Collector slot name
+#     and every JSONL `kind` literal emitted anywhere in the tree must
+#     have a matching backticked row in docs/observability.md (the
+#     `serving` kind was added by hand in PR 6; this makes the doc
+#     contract mechanical)
 #   - the native C++ engine passes g++ -fsyntax-only
 set -e
 cd "$(dirname "$0")/.."
@@ -45,6 +50,65 @@ for p in srcs:
         if p.name == "__init__.py":
             continue
         print(f"UNUSED-IMPORT {p}:{line}: {name}")
+        fail = 1
+
+# -- observability contract drift (slot table + JSONL kinds) --
+# docs/observability.md is the machine-checked contract: every counter
+# slot in metrics.SLOT_NAMES and every JSONL kind the tree can emit
+# (a `kind="x"` keyword on an emit* call, or the default of a `kind`
+# parameter) needs a backticked mention. AST only — lint must not pay
+# a jax import, and a string regex would trip on np.argsort(kind=...).
+doc = pathlib.Path("docs/observability.md").read_text()
+mtree = ast.parse(pathlib.Path("quiver_tpu/metrics.py").read_text())
+slot_names = []
+for node in ast.walk(mtree):
+    if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "SLOT_NAMES"
+            for t in node.targets):
+        slot_names = [v.value for v in node.value.values
+                      if isinstance(v, ast.Constant)]
+if not slot_names:
+    print("DRIFT: could not read SLOT_NAMES from quiver_tpu/metrics.py")
+    fail = 1
+
+def kind_literals(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                getattr(fn, "id", "")
+            if not name.startswith("emit"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "kind" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str):
+                    yield kw.value.value
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = a.posonlyargs + a.args + a.kwonlyargs
+            defaults = ([None] * (len(a.posonlyargs) + len(a.args)
+                                  - len(a.defaults))
+                        + list(a.defaults) + list(a.kw_defaults))
+            for arg, d in zip(params, defaults):
+                if arg.arg == "kind" and isinstance(d, ast.Constant) \
+                        and isinstance(d.value, str):
+                    yield d.value
+
+kind_srcs = srcs + [p for p in pathlib.Path("scripts").glob("*.py")]
+kinds = {}
+for p in kind_srcs:
+    for k in kind_literals(ast.parse(p.read_text())):
+        kinds.setdefault(k, p)
+for name in slot_names:
+    if f"`{name}`" not in doc:
+        print(f"DRIFT: counter slot `{name}` (quiver_tpu/metrics.py "
+              "SLOT_NAMES) has no row in docs/observability.md")
+        fail = 1
+for kind, src in sorted(kinds.items()):
+    if f"`{kind}`" not in doc:
+        print(f"DRIFT: JSONL kind `{kind}` (emitted in {src}) is not "
+              "documented in docs/observability.md")
         fail = 1
 sys.exit(fail)
 EOF

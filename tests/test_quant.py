@@ -13,7 +13,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 import quiver_tpu as qv
 from quiver_tpu.ops import quant

@@ -19,7 +19,14 @@ The contracts under test:
 4. **Zero host syncs** — the jitted serve step's traced program
    contains no callback/infeed equations (``_traffic.host_sync_eqns``),
    with metrics collection on or off, for the plain-array and the
-   Feature-store-backed gather alike.
+   Feature-store-backed gather alike — and independently of whether
+   span tracing is enabled (tracing is host-side only).
+5. **Tracing + SLO** — served logits are bit-identical with tracing on
+   or off; every request leaves admission/coalesce/request spans whose
+   ``batch`` arg names a real batch's dispatch span and whose windows
+   nest consistently (parent/child); the SLO error-budget burn-rate
+   trigger sheds quality (replacing the raw recent-p99 trigger) and
+   the budget block rides the ``serving`` JSONL record.
 """
 
 import json
@@ -34,6 +41,7 @@ import pytest
 
 import quiver_tpu as qv
 from quiver_tpu import metrics as qm
+from quiver_tpu import tracing
 from quiver_tpu.models import GraphSAGE
 from quiver_tpu.ops import sample_multihop
 from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
@@ -271,6 +279,149 @@ class TestOverloadAndShedding:
         assert got["recompiles"] == 0
         report = srv.report()
         assert "per-request latency" in report
+        srv.close()
+
+
+@pytest.fixture
+def traced():
+    """Enable the process-default tracer for one test, guaranteed off
+    (and emptied) afterwards whatever the test does."""
+    tracing.clear()
+    tracing.enable()
+    yield tracing.get_tracer()
+    tracing.disable()
+    tracing.clear()
+
+
+class TestTracingAndSlo:
+    def test_traced_logits_bit_identical(self, world):
+        # tracing is host-side only: with the key chain reset to the
+        # same state, the served logits must match bit for bit with
+        # tracing off vs on (not just allclose). One engine, one
+        # compile — the chain reset replays the exact same program
+        # inputs.
+        model, params, ij, xj, feat = world
+        eng = qv.ServeEngine(model, params, (ij, xj), feat,
+                             sizes_variants=[FULL], batch_cap=CAP,
+                             seed=11)
+        seeds = np.arange(6, dtype=np.int32)
+        off = np.asarray(jax.device_get(eng.run(seeds)))
+        eng._key = jax.random.key(11)        # rewind the donated chain
+        tracing.enable()
+        try:
+            on = np.asarray(jax.device_get(eng.run(seeds)))
+        finally:
+            tracing.disable()
+            tracing.clear()
+        assert np.array_equal(off, on)
+
+    def test_zero_host_syncs_with_tracing_enabled(self, world, traced):
+        # the acceptance pin: tracing+metrics both on, the traced
+        # program still round-trips nothing through the host
+        model, params, ij, xj, feat = world
+        eng = qv.ServeEngine(model, params, (ij, xj), feat,
+                             sizes_variants=[FULL], batch_cap=CAP,
+                             collect_metrics=True)
+        args = (eng.params, jax.random.key(0), eng._feat, eng._forder,
+                eng._indptr, eng._indices, jnp.zeros((CAP,), jnp.int32))
+        assert host_sync_eqns(eng._steps[0].raw, args) == []
+
+    def test_request_spans_correlate_and_nest(self, engine, traced):
+        srv = qv.MicroBatchServer(
+            engine, qv.ServeConfig(max_wait_ms=2.0, queue_depth=128,
+                                   shed_queue_frac=1.0), start=False)
+        futs = [srv.submit(i % 16) for i in range(3 * CAP)]
+        srv.start()
+        for f in futs:
+            f.result(timeout=20)
+        srv.close()
+        recs = traced.records()
+        by_name = {}
+        for r in recs:
+            by_name.setdefault(r[0], []).append(r)
+        n_req = 3 * CAP
+        assert len(by_name["serve.request"]) == n_req
+        assert len(by_name["serve.admission_wait"]) == n_req
+        assert len(by_name["serve.coalesce_wait"]) == n_req
+        n_batches = len(by_name["serve.dispatch"])
+        assert n_batches == len(by_name["serve.scatter"]) \
+            == len(by_name["serve.batch_coalesce"]) >= 3
+        # correlation: every request span's batch arg names a batch
+        # that really dispatched, and the batch saw it in its count
+        batch_ids = {r[4] for r in by_name["serve.dispatch"]}
+        per_req = {}
+        for r in recs:
+            if r[0] in ("serve.request", "serve.admission_wait",
+                        "serve.coalesce_wait"):
+                assert r[5]["batch"] in batch_ids
+                per_req.setdefault(r[4], {})[r[0]] = r
+        assert len(per_req) == n_req
+        # parent/child: admission_wait then coalesce_wait, both inside
+        # the request's total span; the request resolves after its
+        # batch's dispatch began (float clocks: allow tiny slack)
+        eps = 1e-4
+        dispatch_t0 = {r[4]: r[2] for r in by_name["serve.dispatch"]}
+        for rid, spans in per_req.items():
+            adm = spans["serve.admission_wait"]
+            coa = spans["serve.coalesce_wait"]
+            req = spans["serve.request"]
+            assert adm[5]["batch"] == coa[5]["batch"] \
+                == req[5]["batch"]
+            assert adm[2] >= req[2] - eps            # starts at enqueue
+            assert adm[2] + adm[3] <= coa[2] + eps   # then coalesce
+            assert coa[2] + coa[3] <= req[2] + req[3] + eps
+            assert req[2] + req[3] >= dispatch_t0[req[5]["batch"]] - eps
+
+    def test_slo_burn_rate_sheds_quality(self, engine):
+        # a sub-ms p99 target makes every CPU request "bad": the short
+        # window burns at ~1/budget >> shed_burn_rate once min samples
+        # arrive, so later batches MUST take the shed variant (queue
+        # trigger disabled at frac 1.0 to isolate the SLO trigger)
+        srv = qv.MicroBatchServer(
+            engine, qv.ServeConfig(max_wait_ms=1.0, queue_depth=256,
+                                   shed_queue_frac=1.0,
+                                   slo_p99_ms=0.001), start=False)
+        futs = [srv.submit(i % 32) for i in range(120)]
+        srv.start()
+        for f in futs:
+            assert np.isfinite(f.result(timeout=30)).all()
+        s = srv.snapshot()
+        assert s["serving"]["variant_batches"][1] > 0, \
+            "burn-rate trigger never shed"
+        assert s["slo"]["windows"]["short"]["bad"] > 0
+        assert s["slo"]["budget_remaining"] < 0       # overspent
+        srv.close()
+
+    def test_slo_block_and_slo_kind_jsonl(self, engine, tmp_path):
+        srv = qv.MicroBatchServer(
+            engine, qv.ServeConfig(max_wait_ms=2.0, queue_depth=64,
+                                   shed_queue_frac=1.0,
+                                   slo_p99_ms=5000.0))
+        [f.result(timeout=10) for f in srv.submit_many(range(25))]
+        path = tmp_path / "slo.jsonl"
+        with qm.MetricsSink(str(path)) as sink:
+            rec = srv.emit(sink)                      # kind serving
+            srv.slo.emit(sink)                        # kind slo
+        assert rec["slo"]["target_p99_ms"] == 5000.0
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [l["kind"] for l in lines] == ["serving", "slo"]
+        assert lines[0]["slo"]["total"]["requests"] == 25
+        assert lines[1]["target_p99_ms"] == 5000.0
+        assert "burn_rate" in lines[1]["windows"]["short"]
+        # a comfortable 5 s budget on a tiny burst: nothing burns (the
+        # target is huge on purpose — this box lands 100 ms stalls)
+        assert not lines[1]["shedding"]
+        report = srv.report()
+        assert "slo:" in report and "budget remaining" in report
+        srv.close()
+
+    def test_no_slo_budget_without_target(self, engine):
+        srv = qv.MicroBatchServer(
+            engine, qv.ServeConfig(max_wait_ms=1.0, queue_depth=16,
+                                   shed_queue_frac=1.0))
+        assert srv.slo is None
+        srv.submit(1).result(timeout=10)
+        assert "slo" not in srv.snapshot()
         srv.close()
 
 
