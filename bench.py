@@ -486,6 +486,84 @@ def main():
     (feature_gather_rps, host_bytes_per_batch, exchange_bytes_per_batch,
      exchange_cap, exchange_compact_bytes_per_batch, observed,
      observed_cold_rows) = measure_feature_gather()
+
+    # ---- cold-tier (disk mmap) figure: the THIRD rung of the
+    # hierarchy. A small quantized disk-tier artifact (int8 rows +
+    # sidecars, partition.save_disk_tier) served with frontier-ahead
+    # prefetch: batch i+1's ids publish before batch i's consume, so
+    # the mmap read overlaps — cold rows/sec through the prefetched
+    # path plus the OBSERVED ring hit rate (the prefetcher's own
+    # counters; benchmarks/bench_feature.py --ab-prefetch carries the
+    # on/off A/B at full scale).
+    def measure_cold_tier():
+        import shutil
+        import tempfile
+
+        import numpy as _np
+
+        from quiver_tpu.partition import (load_disk_tier_store,
+                                          save_disk_tier)
+
+        c_rows = int(min(n_nodes, 120_000))
+        c_dim = 64
+        c_batch = int(min(2 * batch, c_rows // 2))
+        cache_rows = c_rows // 2
+        n_batches_c = 6
+        rngc = _np.random.default_rng(11)
+        tmp = tempfile.mkdtemp(prefix="qt_bench_cold_")
+        try:
+            featc = rngc.standard_normal((c_rows, c_dim)).astype(
+                _np.float32)
+            save_disk_tier(featc, _np.arange(c_rows, dtype=_np.int64),
+                           tmp, dtype_policy="int8", overwrite=True)
+            store, _meta = load_disk_tier_store(
+                tmp, hot_rows=cache_rows, prefetch_rows=2 * c_batch)
+            pf = store._cold_prefetch
+            # frontier-shaped batches, half the slots on the disk tier
+            ids_c = []
+            for _ in range(n_batches_c):
+                pool = rngc.choice(_np.arange(cache_rows, c_rows),
+                                   size=max(c_batch // 8, 1),
+                                   replace=False)
+                cold_part = pool[rngc.integers(0, pool.size,
+                                               c_batch // 2)]
+                hot_part = rngc.integers(0, cache_rows,
+                                         c_batch - c_batch // 2)
+                a = _np.concatenate([cold_part, hot_part])
+                rngc.shuffle(a)
+                ids_c.append(a.astype(_np.int64))
+            # warmup compiles + stage batch 0 (steady state); the
+            # timed loop's hit rate comes from a counter DELTA so the
+            # warmup's all-sync cold reads don't deflate it
+            jax.block_until_ready(store[jnp.asarray(ids_c[0])])
+            store.stage_frontier(ids_c[0]).result()
+            cold_slots = sum(int((a >= cache_rows).sum()) for a in ids_c)
+            base = pf.counters()
+            t0 = time.perf_counter()
+            for i, a in enumerate(ids_c):
+                r = store[jnp.asarray(a)]
+                if i + 1 < n_batches_c:
+                    store.stage_frontier(ids_c[i + 1])
+                jax.block_until_ready(r)
+            dt = time.perf_counter() - t0
+            hit, sync, staged = (int(v) for v in pf.counters() - base)
+            hit_rate = hit / (hit + sync) if hit + sync else 0.0
+            tracing.record("bench.cold_tier", t0, dt,
+                           args={"batches": n_batches_c,
+                                 "hit_rate": round(hit_rate, 4),
+                                 "staged_rows": staged})
+            store.close()
+            # staged delta excludes the pre-loop batch-0 staging: at a
+            # steady hit rate the ring stages ~one batch's uniques per
+            # batch, so the per-batch figure is the timed delta over
+            # the batches that PUBLISHED during the loop
+            return (cold_slots / dt, hit_rate,
+                    staged / max(n_batches_c - 1, 1))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    (cold_rows_per_s, prefetch_hit_rate,
+     prefetch_staged_rows_per_batch) = measure_cold_tier()
     out = {
         "metric": METRIC,
         "value": round(seps, 1),
@@ -528,6 +606,13 @@ def main():
         "observed_dup_factor": round(observed["dup_factor"], 3)
             if observed["dup_factor"] is not None else None,
         "observed_cold_rows_per_batch": round(observed_cold_rows, 1),
+        # the disk rung, prefetched: cold-tier rows/sec through the
+        # frontier-ahead staging path and the OBSERVED ring hit rate
+        # (bench_regress.py tracks both as their own trajectory groups)
+        "cold_rows_per_s": round(cold_rows_per_s, 1),
+        "prefetch_hit_rate": round(prefetch_hit_rate, 4),
+        "prefetch_staged_rows_per_batch":
+            round(prefetch_staged_rows_per_batch, 1),
     }
     # every measured rotation config, for the record (always present so
     # log consumers never hit a missing key)

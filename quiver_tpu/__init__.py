@@ -34,14 +34,21 @@ from .partition import (
     partition_feature_without_replication,
     save_quantized_feature_partition,
     load_quantized_feature_partition,
+    save_disk_tier,
+    load_disk_tier,
+    load_disk_tier_store,
 )
 from .ops.quant import QuantizedTensor, plan_hot_capacity
 from .hetero import HeteroCSRTopo, HeteroGraphSageSampler
 from .hetero_feature import HeteroFeature
-from .async_sampler import AsyncNeighborSampler, AsyncCudaNeighborSampler
+from .async_sampler import (AsyncNeighborSampler, AsyncCudaNeighborSampler,
+                            sample_ahead)
+from .prefetch import ColdPrefetcher, StagingRing
 from .debug import show_tensor_info
 from .inference import layerwise_inference
-from .datasets import GraphDataset, from_numpy_dir
+from .datasets import (GraphDataset, from_numpy_dir,
+                       generate_synthetic_cold_dataset,
+                       load_synthetic_cold_dataset)
 from .pipeline import Pipeline, pipelined
 from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
@@ -57,6 +64,8 @@ getNcclId = get_comm_id
 __all__ = [
     "GraphDataset",
     "from_numpy_dir",
+    "generate_synthetic_cold_dataset",
+    "load_synthetic_cold_dataset",
     "CSRTopo",
     "parse_size",
     "reindex_by_config",
@@ -91,6 +100,12 @@ __all__ = [
     "HeteroGraphSageSampler",
     "AsyncNeighborSampler",
     "AsyncCudaNeighborSampler",
+    "sample_ahead",
+    "ColdPrefetcher",
+    "StagingRing",
+    "save_disk_tier",
+    "load_disk_tier",
+    "load_disk_tier_store",
     "show_tensor_info",
     "layerwise_inference",
     "Pipeline",

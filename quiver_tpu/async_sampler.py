@@ -43,5 +43,46 @@ class AsyncNeighborSampler:
         return layer.n_id, layer.row, layer.col
 
 
+def sample_ahead(sampler, seed_batches, feature=None, depth: int = 2):
+    """Drive ``sampler.sample`` ONE batch ahead on a bounded
+    :class:`~quiver_tpu.pipeline.Pipeline`, publishing each sampled
+    batch's frontier to ``feature``'s cold-tier prefetcher the moment
+    the sample completes — the sampler side of the frontier-ahead
+    disk-prefetch loop (see ``quiver_tpu.prefetch``).
+
+    Yields ``sampler.sample(seeds)`` results in submission order. With
+    ``depth=2`` (double-buffer), while the caller consumes batch *i*
+    (gathers features, runs the model step), batch *i+1* is sampling on
+    the pipeline worker and — as soon as its frontier ids exist —
+    published via ``feature.stage_frontier(n_id)``, so the prefetcher's
+    disk read overlaps batch *i*'s compute. The publication happens on
+    the worker thread: a device-array frontier blocks *there*, never
+    the training loop. ``feature=None`` degenerates to plain
+    sample-ahead pipelining (no publication).
+
+    ::
+
+        pf = store.enable_cold_prefetch(capacity_rows=1 << 16)
+        for n_id, bs, adjs in sample_ahead(sampler, seeds, store):
+            x = store[n_id]           # staged rows: no disk stall
+            state, loss = step(state, x, adjs, ...)
+    """
+    from .pipeline import Pipeline
+    pipe = Pipeline(depth=depth, name="quiver-sample-ahead")
+
+    def _stage(seeds):
+        out = sampler.sample(seeds)
+        if feature is not None:
+            # out[0] is the batch's n_id: hop-0 seeds + every sampled
+            # hop's ids — exactly the frontier the gather will request
+            feature.stage_frontier(out[0])
+        return out
+
+    try:
+        yield from pipe.map(_stage, seed_batches)
+    finally:
+        pipe.close()
+
+
 # reference-compatible alias
 AsyncCudaNeighborSampler = AsyncNeighborSampler

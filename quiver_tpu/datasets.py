@@ -29,6 +29,7 @@ into ``CSRTopo`` + ``Feature`` + the train loops
 
 from __future__ import annotations
 
+import json
 import os
 from typing import NamedTuple, Optional
 
@@ -161,3 +162,178 @@ def from_numpy_dir(path: str, undirected: bool = False) -> GraphDataset:
                         train_idx=_idx("train_idx"),
                         valid_idx=_idx("valid_idx"),
                         test_idx=_idx("test_idx"))
+
+
+# -- synthetic bigger-than-RAM (papers100M-shaped) generator ----------------
+# The cold-tier machinery needs a graph whose feature rows do NOT fit
+# in RAM to be benchable on one host; no dataset egress exists here, so
+# generate one: power-law degrees sorted DESCENDING (identity storage
+# order IS the hot order — no permutation artifact needed), skewed
+# neighbor popularity (frontiers hit hot rows super-uniformly, like
+# real degree-proportional access), and the feature rows streamed in
+# chunks straight into a quantized disk-tier artifact
+# (partition.save_disk_tier) — the full-width feature matrix never
+# materializes, so nodes=111M (papers100M scale, a ~15 GB int8
+# artifact at dim 128) generates in bounded memory.
+
+_COLD_META = "meta.json"
+
+#: internal generation block (rows/edges): content is produced per
+#: FIXED block keyed by (seed, block start), so ``chunk_rows`` — the
+#: streaming/IO unit — cannot change the dataset (pinned in
+#: tests/test_prefetch.py)
+_GEN_BLOCK = 8192
+
+
+def _gen_block(seed: int, lo: int, hi: int, total: int, shape_tail, fn):
+    """Values [lo, hi) assembled from fixed ``_GEN_BLOCK``-sized
+    deterministic blocks of the [0, total) stream: ``fn(rng, count)``
+    draws one block's worth. Block boundaries depend only on ``total``,
+    never on the requested [lo, hi) — chunk-size invariant."""
+    out = None
+    b = (lo // _GEN_BLOCK) * _GEN_BLOCK
+    while b < hi:
+        be = min(b + _GEN_BLOCK, total)
+        block = fn(np.random.default_rng([seed, b]), be - b)
+        s, e = max(lo, b), min(hi, be)
+        if out is None:
+            out = np.empty((hi - lo,) + tuple(shape_tail), block.dtype)
+        out[s - lo:e - lo] = block[s - b:e - b]
+        b = be
+    return out
+
+
+def generate_synthetic_cold_dataset(out_dir: str, nodes: int = 1_000_000,
+                                    dim: int = 128, avg_deg: int = 15,
+                                    hot_frac: float = 0.05,
+                                    dtype_policy: str = "int8",
+                                    skew: float = 2.0, classes: int = 64,
+                                    seed: int = 0,
+                                    chunk_rows: int = 1 << 17,
+                                    overwrite: bool = False) -> dict:
+    """Write a synthetic papers100M-SHAPED dataset whose feature rows
+    live on disk::
+
+        out_dir/indptr.npy, indices.npy     (CSR; degrees descending)
+        out_dir/labels.npy
+        out_dir/hot_rows.npy                (first ceil(hot_frac * N)
+                                             rows, DECODED — the HBM
+                                             tier seed)
+        out_dir/disk/...                    (save_disk_tier artifact
+                                             spanning ALL N rows;
+                                             disk_map = identity)
+        out_dir/meta.json
+
+    Neighbor ids draw as ``floor(N * u**skew)`` — density concentrated
+    on the low (high-degree, HBM-cached) ids, so sampled frontiers show
+    a realistic hot-tier hit rate instead of the uniform ``hot_frac``.
+    ``hot_rows.npy`` holds the *decoded* quantized rows, so HBM and
+    disk lookups agree exactly (quantization error lives in the
+    artifact once, not in the tier boundary).
+    ``load_synthetic_cold_dataset`` rebuilds ``(CSRTopo, Feature)``.
+    """
+    from .ops import quant
+    from .partition import save_disk_tier
+
+    if not 0.0 < hot_frac <= 1.0:
+        raise ValueError(f"hot_frac must be in (0, 1], got {hot_frac}")
+    os.makedirs(out_dir, exist_ok=True)
+    meta_path = os.path.join(out_dir, _COLD_META)
+    if os.path.exists(meta_path) and not overwrite:
+        raise FileExistsError(
+            f"{meta_path} exists; pass overwrite=True to replace it")
+    rng = np.random.default_rng(seed)
+
+    # graph: lognormal degrees, sorted descending (storage order = hot
+    # order), neighbor popularity ∝ the same ordering via u**skew
+    deg = np.clip(np.exp(rng.normal(np.log(max(avg_deg, 1)), 1.0,
+                                    nodes)), 0, 50_000).astype(np.int64)
+    deg[::-1].sort()                     # descending, in place
+    indptr = np.zeros(nodes + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    e = int(indptr[-1])
+    idx_path = os.path.join(out_dir, "indices.npy")
+    indices = np.lib.format.open_memmap(idx_path, mode="w+",
+                                        dtype=np.int32, shape=(e,))
+
+    def draw_edges(r, k):
+        return np.minimum((nodes * r.random(k) ** skew),
+                          nodes - 1).astype(np.int32)
+
+    edge_chunk = max(chunk_rows * max(avg_deg, 1), 1 << 20)
+    for lo in range(0, e, edge_chunk):
+        hi = min(lo + edge_chunk, e)
+        indices[lo:hi] = _gen_block(seed + 1, lo, hi, e, (), draw_edges)
+    indices.flush()
+    np.save(os.path.join(out_dir, "indptr.npy"), indptr)
+    np.save(os.path.join(out_dir, "labels.npy"),
+            rng.integers(0, classes, nodes).astype(np.int32))
+
+    # features: streamed through quantization into the disk artifact
+    def read_chunk(lo, hi):
+        return _gen_block(
+            seed + 2, lo, hi, nodes, (dim,),
+            lambda r, k: r.standard_normal((k, dim)).astype(np.float32))
+
+    disk_dir = os.path.join(out_dir, "disk")
+    tier_meta = save_disk_tier((read_chunk, nodes, dim),
+                               np.arange(nodes, dtype=np.int64),
+                               disk_dir, dtype_policy=dtype_policy,
+                               overwrite=overwrite,
+                               chunk_rows=chunk_rows)
+
+    # hot tier seed: the DECODED first rows of the artifact (chunked)
+    hot_rows = max(int(np.ceil(nodes * hot_frac)), 1)
+    mm = np.load(os.path.join(disk_dir, "disk_rows.npy"), mmap_mode="r")
+    if tier_meta["dtype_policy"] == "int8":
+        tier = quant.QuantizedTensor(
+            mm, np.load(os.path.join(disk_dir, "disk_scale.npy")),
+            np.load(os.path.join(disk_dir, "disk_zero.npy")))
+    else:
+        tier = mm
+    hot = np.lib.format.open_memmap(
+        os.path.join(out_dir, "hot_rows.npy"), mode="w+",
+        dtype=np.dtype(tier_meta["logical_dtype"]), shape=(hot_rows, dim))
+    for lo in range(0, hot_rows, chunk_rows):
+        hi = min(lo + chunk_rows, hot_rows)
+        hot[lo:hi] = quant.take_np(tier, np.arange(lo, hi))
+    hot.flush()
+    del mm, hot
+
+    meta = {"kind": "synthetic_cold", "nodes": nodes, "dim": dim,
+            "edges": e, "avg_deg": avg_deg, "hot_rows": hot_rows,
+            "hot_frac": hot_frac, "skew": skew, "classes": classes,
+            "seed": seed, "dtype_policy": tier_meta["dtype_policy"]}
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    return meta
+
+
+def load_synthetic_cold_dataset(out_dir: str,
+                                prefetch_rows: Optional[int] = None,
+                                depth: int = 2,
+                                decode_staged: bool = True):
+    """Rebuild a generated dataset as framework-native structures:
+    ``(csr_topo, feature, meta)``. The :class:`~quiver_tpu.feature.
+    Feature` holds ``hot_rows.npy`` in the HBM tier and the full row
+    space on the mmap disk tier; ``prefetch_rows`` attaches the
+    frontier-keyed cold prefetcher (``enable_cold_prefetch``) with that
+    ring capacity. The caller owns ``feature.close()``."""
+    from .feature import DeviceConfig, Feature
+    from .partition import load_disk_tier
+
+    with open(os.path.join(out_dir, _COLD_META)) as fh:
+        meta = json.load(fh)
+    indptr = np.load(os.path.join(out_dir, "indptr.npy"))
+    indices = np.load(os.path.join(out_dir, "indices.npy"),
+                      mmap_mode="r")
+    topo = CSRTopo(indptr=indptr, indices=indices)
+    hot = np.load(os.path.join(out_dir, "hot_rows.npy"))
+    store = Feature()
+    store.from_mmap(None, DeviceConfig([hot], None))
+    kwargs, _ = load_disk_tier(os.path.join(out_dir, "disk"))
+    store.set_mmap_file(**kwargs)
+    if prefetch_rows:
+        store.enable_cold_prefetch(prefetch_rows, depth=depth,
+                                   decode_staged=decode_staged)
+    return topo, store, meta

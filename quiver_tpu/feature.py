@@ -158,8 +158,10 @@ class Feature:
         self._host_offload = None      # pinned_host jnp [rest, dim]
         self.mmap_array = None
         self.disk_map = None
+        self._disk_map_np = None       # (src, host copy) cache
         self.disk_scale = None
         self.disk_zero = None
+        self._cold_prefetch = None     # prefetch.ColdPrefetcher
         self._gather_cached = None
         self._translate = None
         self._lookup_cached = None
@@ -706,20 +708,14 @@ class Feature:
             col.add(_m.HOT_ROWS,
                     (ids >= 0).sum() if masked else ids.shape[0])
             return rows, col.counters()
+        pf = self._cold_prefetch
+        pf_before = pf.counters() if pf is not None else None
         rows = self.getitem_masked(ids) if masked else self[ids]
         from . import metrics as _m
         ids_np = np.asarray(jax.device_get(ids)).astype(np.int64)
         valid = (ids_np >= 0) if masked else np.ones_like(ids_np, bool)
-        if self.feature_order is not None:
-            # the order is immutable once built and O(n_nodes) — cache
-            # its host copy (keyed by identity so a rebuilt store
-            # invalidates) instead of a full D2H transfer per lookup
-            if (self._order_np is None
-                    or self._order_np[0] is not self.feature_order):
-                self._order_np = (self.feature_order,
-                                  np.asarray(jax.device_get(
-                                      self.feature_order)))
-            order = self._order_np[1]
+        order = self._order_host()
+        if order is not None:
             t = order[np.clip(ids_np, 0, order.shape[0] - 1)]
         else:
             t = np.clip(ids_np, 0, max(self.size(0) - 1, 0))
@@ -739,6 +735,17 @@ class Feature:
             if budget < int(ids_np.shape[0]):
                 vec[_m.DEDUP_TOTAL] = int(valid.sum())
                 vec[_m.DEDUP_UNIQUE] = int(np.unique(t[valid]).size)
+        if pf_before is not None:
+            # the prefetch rows THIS lookup's gather consumed: hit and
+            # sync counts are exact (``gather`` ran synchronously on
+            # this thread inside the lookup above); staged rows drain —
+            # a batch's publication runs during the PREVIOUS step, so
+            # everything staged since the last metered lookup is this
+            # batch's staged-rows/batch figure
+            d = pf.counters() - pf_before
+            vec[_m.PREFETCH_HIT_ROWS] = int(d[0])
+            vec[_m.PREFETCH_SYNC_ROWS] = int(d[1])
+            vec[_m.PREFETCH_STAGED_ROWS] = pf.drain_staged()
         return rows, vec
 
     def prefetch(self, node_idx):
@@ -759,19 +766,89 @@ class Feature:
         return self._pool.submit(self.__getitem__, ids)
 
     def close(self):
-        """Shut down the prefetch pipeline (idempotent). Without an
-        explicit call the pipeline's ``weakref.finalize`` stops the
-        worker when the store is collected — long runs that churn
-        Feature objects no longer accumulate staging threads."""
+        """Shut down the staging pipelines (idempotent): the lookup
+        prefetch pipeline and, when attached, the cold-tier prefetcher.
+        Without an explicit call each pipeline's ``weakref.finalize``
+        stops its worker when the store is collected — long runs that
+        churn Feature objects no longer accumulate staging threads."""
         pool, self._pool = self._pool, None
         if pool is not None:
             pool.close()
+        pf, self._cold_prefetch = self._cold_prefetch, None
+        if pf is not None:
+            pf.close()
+
+    # -- host copies of immutable device metadata ---------------------------
+    def _order_host(self) -> Optional[np.ndarray]:
+        """Host copy of ``feature_order`` (immutable once built and
+        O(n_nodes) — cached keyed by identity so a rebuilt store
+        invalidates, instead of a full D2H transfer per use)."""
+        if self.feature_order is None:
+            return None
+        if (self._order_np is None
+                or self._order_np[0] is not self.feature_order):
+            self._order_np = (self.feature_order,
+                              np.asarray(jax.device_get(
+                                  self.feature_order)))
+        return self._order_np[1]
+
+    def _disk_map_host(self) -> np.ndarray:
+        """Host copy of ``disk_map`` (same identity-keyed caching as
+        :meth:`_order_host`; the old per-read ``device_get`` paid a
+        full O(n_nodes) transfer on every cold read)."""
+        if (self._disk_map_np is None
+                or self._disk_map_np[0] is not self.disk_map):
+            self._disk_map_np = (self.disk_map,
+                                 np.asarray(jax.device_get(
+                                     self.disk_map)))
+        return self._disk_map_np[1]
+
+    # -- cold-tier (disk) prefetch ------------------------------------------
+    def enable_cold_prefetch(self, capacity_rows: int = 65_536,
+                             depth: int = 2, decode_staged: bool = True,
+                             wait_inflight: bool = True):
+        """Attach a frontier-keyed asynchronous prefetcher to the mmap
+        disk tier (requires :meth:`set_mmap_file` first): publish a
+        FUTURE batch's frontier with :meth:`stage_frontier` (or drive
+        the loop with ``async_sampler.sample_ahead``) and the disk read
+        overlaps the current step's compute — lookups consult the
+        fixed-capacity staging ring first; a miss waits for a staging
+        task still in flight (``wait_inflight`` — the read is already
+        running, re-issuing it would pay the disk twice) and finally
+        falls back to the synchronous read, counted
+        (``metrics.PREFETCH_SYNC_ROWS``), never wrong. Returns the
+        :class:`~quiver_tpu.prefetch.ColdPrefetcher` (re-attaching
+        replaces — and closes — a previous one)."""
+        if self.mmap_array is None or self.disk_map is None:
+            raise ValueError("enable_cold_prefetch needs an mmap disk "
+                             "tier (call set_mmap_file first)")
+        from .prefetch import ColdPrefetcher
+        if self._cold_prefetch is not None:
+            self._cold_prefetch.close()
+        self._cold_prefetch = ColdPrefetcher(
+            self, capacity_rows, depth=depth,
+            decode_staged=decode_staged, wait_inflight=wait_inflight)
+        return self._cold_prefetch
+
+    def stage_frontier(self, node_idx):
+        """Publish a FUTURE batch's frontier ids (-1 padding fine) to
+        the cold-tier prefetcher. Non-blocking: returns the staging
+        ``Future``, or None when no prefetcher is attached or the
+        prefetcher is saturated (the publication is dropped — later
+        reads fall back to the synchronous path)."""
+        pf = self._cold_prefetch
+        if pf is None:
+            return None
+        return pf.publish(node_idx)
 
     def _read_cold(self, cold_ids: np.ndarray) -> np.ndarray:
         if self.mmap_array is not None and self.disk_map is not None:
             # disk_map is indexed by storage row (reference feature.py:84-93)
             rows = cold_ids + self.cache_rows
-            disk_rows = np.asarray(jax.device_get(self.disk_map))[rows]
+            disk_rows = self._disk_map_host()[rows]
+            pf = self._cold_prefetch
+            if pf is not None:
+                return pf.gather(disk_rows, self._dequant_disk)
             return self._dequant_disk(disk_rows)
         if self.host_part is None:
             raise IndexError("ids beyond the cached tier but no host tier")
@@ -779,19 +856,99 @@ class Feature:
 
     # -- disk tier (reference feature.py:84-93) -----------------------------
     def set_mmap_file(self, path, disk_map, scale=None, zero=None):
-        """``scale``/``zero`` (paths or arrays, [rows, 1] fp32) mark the
-        mmap file as an int8-quantized tier: disk reads dequantize
-        per-row after the mmap fancy-index, so the DISK traffic is the
-        narrow width too (the sidecars are resident, ~8 B/row)."""
-        self.mmap_array = np.load(path, mmap_mode="r")
-        self.disk_map = jnp.asarray(disk_map)
+        """``scale``/``zero`` (paths or arrays, [rows] or [rows, 1],
+        one per MMAP row) mark the mmap file as an int8-quantized tier:
+        disk reads dequantize per-row after the mmap fancy-index, so
+        the DISK traffic is the narrow width too (the sidecars are
+        resident, ~8 B/row).
+
+        The map and the file are VALIDATED here — a bad ``disk_map``
+        (too short, or cold-region entries outside the mmap's rows) or
+        a dtype that contradicts the store's policy used to gather
+        garbage rows silently (negative entries wrap in numpy fancy
+        indexing); every mismatch now raises at attach time. Entries
+        for rows below ``cache_rows`` are never read (those rows live
+        in HBM) and may hold any sentinel. Re-attaching a tier drops a
+        previously enabled cold prefetcher (its ring indexes the old
+        file) — call :meth:`enable_cold_prefetch` again after."""
+        arr = np.load(path, mmap_mode="r")
+        if arr.ndim != 2:
+            raise ValueError(
+                f"mmap feature file must be [rows, dim], got shape "
+                f"{arr.shape}")
+        dm = np.asarray(jax.device_get(disk_map) if not
+                        isinstance(disk_map, np.ndarray) else disk_map)
+        if dm.ndim != 1 or not np.issubdtype(dm.dtype, np.integer):
+            raise ValueError(
+                "disk_map must be a 1-D integer array mapping storage "
+                f"row -> mmap row, got shape {dm.shape} dtype {dm.dtype}")
+        if dm.shape[0] < self.cache_rows:
+            raise ValueError(
+                f"disk_map has {dm.shape[0]} entries but the HBM tier "
+                f"already holds {self.cache_rows} rows — the map must "
+                "span the full logical id space (it defines shape[0])")
+        cold = dm[self.cache_rows:]
+        bad = int(((cold < 0) | (cold >= arr.shape[0])).sum())
+        if bad:
+            raise ValueError(
+                f"{bad} disk_map entries in the cold region (storage "
+                f"rows >= {self.cache_rows}) fall outside the mmap's "
+                f"{arr.shape[0]} rows — negative entries wrap in numpy "
+                "fancy indexing and would gather garbage rows silently")
+        dim = None
+        for tier in (self.device_part, self.host_part,
+                     self._host_offload):
+            if tier is not None:
+                dim = quant.tier_dim(tier)
+                break
+        if dim is not None and arr.shape[1] != dim:
+            raise ValueError(
+                f"mmap rows are {arr.shape[1]} wide but the store's "
+                f"resident tiers are {dim} wide")
         load = lambda s: (None if s is None else
                           np.load(s) if isinstance(s, str) else np.asarray(s))
-        self.disk_scale = load(scale)
-        self.disk_zero = load(zero)
-        if (self.disk_scale is None) != (self.disk_zero is None):
+        ds, dz = load(scale), load(zero)
+        if (ds is None) != (dz is None):
             raise ValueError("quantized disk tier needs BOTH scale and "
                              "zero sidecars")
+        if ds is not None:
+            ds = ds[:, None] if ds.ndim == 1 else ds
+            dz = dz[:, None] if dz.ndim == 1 else dz
+            want = (arr.shape[0], 1)
+            if tuple(ds.shape) != want or tuple(dz.shape) != want:
+                raise ValueError(
+                    f"scale/zero sidecars must be [rows, 1] aligned "
+                    f"with the mmap ({want}), got {tuple(ds.shape)} / "
+                    f"{tuple(dz.shape)}")
+            if arr.dtype != np.int8:
+                raise ValueError(
+                    "scale/zero sidecars mark an int8-quantized tier "
+                    f"but the mmap dtype is {arr.dtype}")
+        else:
+            if arr.dtype == np.int8:
+                raise ValueError(
+                    "int8 mmap without scale/zero sidecars would be "
+                    "returned as raw codes — pass the sidecars (or "
+                    "store the file dequantized)")
+            if self.dtype_policy["cold"] == "int8":
+                raise ValueError(
+                    "store's cold dtype policy is int8 but the mmap "
+                    f"tier is un-sidecar'd {arr.dtype} — quantize the "
+                    "file (partition.save_disk_tier) or drop the policy")
+        self.mmap_array = arr
+        self.disk_map = jnp.asarray(dm)
+        self._disk_map_np = (self.disk_map, dm)
+        self.disk_scale = ds
+        self.disk_zero = dz
+        if self._translate is None:
+            # a bare Feature whose ONLY tier is the disk map (no
+            # from_cpu_tensor/from_mmap ran) still needs the lookup
+            # closures — without this the first lookup dies on a None
+            # _translate
+            self._build_gather()
+        if self._cold_prefetch is not None:
+            self._cold_prefetch.close()
+            self._cold_prefetch = None
 
     def _dequant_disk(self, disk_rows: np.ndarray) -> np.ndarray:
         if getattr(self, "disk_scale", None) is None:
@@ -848,7 +1005,9 @@ class Feature:
                  if k not in ("_gather_cached", "_translate",
                               "_lookup_cached", "_lookup_cached_masked",
                               "_lookup_tiered", "_lookup_tiered_raw",
-                              "_host_offload", "_pool")}
+                              "_host_offload", "_pool",
+                              "_cold_prefetch", "_disk_map_np",
+                              "_order_np")}
         # the pinned_host array doesn't pickle; round-trip its contents
         # through numpy and re-place on load
         if self._host_offload is not None and state.get("host_part") is None:
@@ -866,6 +1025,9 @@ class Feature:
         self._lookup_tiered_raw = None
         self._host_offload = None
         self._pool = None
+        self._cold_prefetch = None     # threads never round-trip pickle
+        self._disk_map_np = None
+        self._order_np = None
         # older pickles predate the knobs
         self.__dict__.setdefault("cold_budget", None)
         self.__dict__.setdefault("dedup_cold", False)

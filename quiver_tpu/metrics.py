@@ -81,8 +81,11 @@ EXCH_CAP = 9          # the per-owner cap in force               [max slot]
 FRONTIER_VALID = 10   # valid final-frontier slots out of sampling
 FRONTIER_CAP = 11     # static final-frontier capacity
 DEDUP_CALLS = 12      # dedup compactions recorded
+PREFETCH_HIT_ROWS = 13    # disk-tier rows served from the staging ring
+PREFETCH_SYNC_ROWS = 14   # disk-tier rows read synchronously (ring miss)
+PREFETCH_STAGED_ROWS = 15  # rows the cold prefetcher staged into the ring
 
-NUM_COUNTERS = 16     # slots 13..15 reserved
+NUM_COUNTERS = 16
 
 #: slots merged with ``max`` across steps/shards; all others add
 MAX_SLOTS = (EXCH_BUCKET_MAX, EXCH_CAP)
@@ -95,6 +98,9 @@ SLOT_NAMES = {
     EXCH_BUCKET_MAX: "exchange_bucket_max", EXCH_CAP: "exchange_cap",
     FRONTIER_VALID: "frontier_valid", FRONTIER_CAP: "frontier_cap",
     DEDUP_CALLS: "dedup_calls",
+    PREFETCH_HIT_ROWS: "prefetch_hit_rows",
+    PREFETCH_SYNC_ROWS: "prefetch_sync_rows",
+    PREFETCH_STAGED_ROWS: "prefetch_staged_rows",
 }
 
 _MAX_MASK_NP = np.zeros((NUM_COUNTERS,), bool)
@@ -186,6 +192,9 @@ def derive(counters) -> Dict[str, Optional[float]]:
         "exchange_fallback_rate": ratio(c[EXCH_FALLBACK], c[EXCH_CALLS]),
         "exchange_bucket_peak_frac": ratio(c[EXCH_BUCKET_MAX], c[EXCH_CAP]),
         "frontier_fill": ratio(c[FRONTIER_VALID], c[FRONTIER_CAP]),
+        "prefetch_hit_rate": ratio(
+            c[PREFETCH_HIT_ROWS],
+            c[PREFETCH_HIT_ROWS] + c[PREFETCH_SYNC_ROWS]),
     }
 
 
@@ -411,6 +420,12 @@ class StepStats:
             f" = {fmt(d['exchange_bucket_peak_frac'], pct=True)} of cap)",
             f"frontier fill: {fmt(d['frontier_fill'], pct=True)}",
         ]
+        if c["prefetch_hit_rows"] or c["prefetch_sync_rows"]:
+            lines.append(
+                f"cold-tier prefetch hit rate: "
+                f"{fmt(d['prefetch_hit_rate'], pct=True)}  "
+                f"({c['prefetch_staged_rows']} rows staged, "
+                f"{c['prefetch_sync_rows']} sync fallbacks)")
         if "request" in s:
             r = s["request"]
             lines.insert(1, (

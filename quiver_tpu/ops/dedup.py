@@ -120,6 +120,17 @@ def dedup_take(table: jax.Array, ids: jax.Array, budget: int,
     return jax.lax.cond(n_uniq > budget, full, narrow, None)
 
 
+def unique_np(ids, valid=None) -> np.ndarray:
+    """Host-side frontier dedup — the numpy mirror of
+    ``unique_within_budget`` minus the static budget (the cold-tier
+    prefetcher's staging thread runs on the host, where data-dependent
+    shapes are free): the sorted distinct VALID ids. ``valid=None``
+    treats negative ids as padding, matching the device convention."""
+    ids = np.asarray(ids)
+    mask = (ids >= 0) if valid is None else (np.asarray(valid) & (ids >= 0))
+    return np.unique(ids[mask])
+
+
 def compact_exchange_slots(ids, cap: int, hosts: int,
                            owner=None) -> int:
     """Analytic mirror of ``comm.dist_lookup_local``'s compact-exchange

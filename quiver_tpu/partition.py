@@ -201,3 +201,152 @@ def load_quantized_feature_partition(partition_idx: int, result_path: str,
     scale = np.load(os.path.join(part_dir, "feature_scale.npy"))
     zero = np.load(os.path.join(part_dir, "feature_zero.npy"))
     return quant.QuantizedTensor(rows, scale, zero), meta
+
+
+# -- cold-tier (disk / NVMe-mmap) artifacts --------------------------------
+# The third tier of the storage hierarchy (HBM hot / host-RAM warm /
+# disk cold): a single mmap-able rows file + resident sidecars + the
+# storage-row -> mmap-row map, exactly what ``Feature.set_mmap_file``
+# consumes. Composes with the quantized format above: int8 rows keep
+# the DISK traffic (and the file itself) at the narrow width.
+
+def save_disk_tier(feat_rows, disk_map, result_path: str,
+                   dtype_policy="int8", overwrite: bool = False,
+                   chunk_rows: int = 1 << 18):
+    """Persist a cold-tier artifact::
+
+        result_path/disk_rows.npy            (mmap-able storage rows)
+        result_path/disk_scale.npy, disk_zero.npy   (int8 policy only)
+        result_path/disk_map.npy             (storage row -> mmap row)
+        result_path/dtype_meta.json
+
+    ``feat_rows`` is the mmap rows' content: an ``[n, dim]`` array, or
+    — the bigger-than-RAM path — ``(chunk_reader, n, dim)`` where
+    ``chunk_reader(lo, hi)`` returns rows ``[lo, hi)``; either way rows
+    stream through quantization ``chunk_rows`` at a time into an
+    ``open_memmap``, so the full-width array never materializes.
+    ``disk_map`` spans the FULL logical id space (entries below a
+    store's ``cache_rows`` are never read). Policies: ``None``/"fp32",
+    "fp16", "int8" ("bf16" is refused — ``np.load(mmap_mode="r")``
+    cannot reconstruct the ml_dtypes dtype from disk).
+
+    ``load_disk_tier(result_path)`` hands back ``set_mmap_file`` kwargs.
+    """
+    policy = quant.resolve_policy(dtype_policy)
+    if policy == "bf16":
+        raise ValueError("bf16 disk tiers are not mmap-loadable "
+                         "(np.load cannot rebuild the dtype); use "
+                         "fp16 or int8")
+    if callable(getattr(feat_rows, "__getitem__", None)) and \
+            not isinstance(feat_rows, tuple):
+        feat_rows = np.asarray(feat_rows)
+        reader = lambda lo, hi: feat_rows[lo:hi]
+        rows, dim = feat_rows.shape
+    else:
+        reader, rows, dim = feat_rows
+        rows, dim = int(rows), int(dim)
+    os.makedirs(result_path, exist_ok=True)
+    rows_path = os.path.join(result_path, "disk_rows.npy")
+    if os.path.exists(rows_path) and not overwrite:
+        raise FileExistsError(
+            f"{rows_path} exists; pass overwrite=True to replace it")
+    probe = np.asarray(reader(0, min(1, rows)))
+    logical_dtype = probe.dtype
+    storage_dtype = {None: logical_dtype, "fp16": np.dtype(np.float16),
+                     "int8": np.dtype(np.int8)}[policy]
+    out = np.lib.format.open_memmap(rows_path, mode="w+",
+                                    dtype=storage_dtype,
+                                    shape=(rows, dim))
+    scale = zero = None
+    if policy == "int8":
+        scale = np.lib.format.open_memmap(
+            os.path.join(result_path, "disk_scale.npy"), mode="w+",
+            dtype=logical_dtype, shape=(rows, 1))
+        zero = np.lib.format.open_memmap(
+            os.path.join(result_path, "disk_zero.npy"), mode="w+",
+            dtype=logical_dtype, shape=(rows, 1))
+    for lo in range(0, rows, chunk_rows):
+        hi = min(lo + chunk_rows, rows)
+        q = quant.quantize(np.asarray(reader(lo, hi)), policy)
+        if quant.is_quantized(q):
+            out[lo:hi] = q.data
+            scale[lo:hi] = q.scale
+            zero[lo:hi] = q.zero
+        else:
+            out[lo:hi] = q
+    out.flush()
+    if scale is not None:
+        scale.flush()
+        zero.flush()
+    np.save(os.path.join(result_path, "disk_map.npy"),
+            np.asarray(disk_map))
+    meta = {"kind": "disk_tier", "dtype_policy": policy or "fp32",
+            "logical_dtype": str(logical_dtype),
+            "storage_dtype": str(storage_dtype),
+            "rows": rows, "dim": dim,
+            "map_rows": int(np.asarray(disk_map).shape[0])}
+    with open(os.path.join(result_path, _DTYPE_META), "w") as fh:
+        json.dump(meta, fh)
+    return meta
+
+
+def load_disk_tier(result_path: str):
+    """Load a :func:`save_disk_tier` artifact. Returns
+    ``(kwargs, meta)`` where ``Feature.set_mmap_file(**kwargs)``
+    attaches the tier (the rows file stays a PATH so the store mmaps
+    it; int8 sidecars pass as paths too and load resident). Refuses an
+    artifact whose rows file no longer matches its recorded meta — a
+    mis-described file would be mis-decoded byte-for-byte."""
+    with open(os.path.join(result_path, _DTYPE_META)) as fh:
+        meta = json.load(fh)
+    if meta.get("kind") != "disk_tier":
+        raise ValueError(
+            f"{result_path} holds a {meta.get('kind', 'partition')!r} "
+            "artifact, not a disk_tier one")
+    rows_path = os.path.join(result_path, "disk_rows.npy")
+    arr = np.load(rows_path, mmap_mode="r")
+    if str(arr.dtype) != meta["storage_dtype"] or \
+            list(arr.shape) != [meta["rows"], meta["dim"]]:
+        raise ValueError(
+            f"{rows_path} is {arr.shape} {arr.dtype} but its meta "
+            f"records [{meta['rows']}, {meta['dim']}] "
+            f"{meta['storage_dtype']} — refusing to mis-decode")
+    kwargs = {"path": rows_path,
+              "disk_map": np.load(os.path.join(result_path,
+                                               "disk_map.npy"))}
+    if meta["dtype_policy"] == "int8":
+        kwargs["scale"] = os.path.join(result_path, "disk_scale.npy")
+        kwargs["zero"] = os.path.join(result_path, "disk_zero.npy")
+    return kwargs, meta
+
+
+def load_disk_tier_store(result_path: str, hot_rows: int = 0,
+                         prefetch_rows=None, **prefetch_kwargs):
+    """The ONE artifact-to-store recipe: build a ``Feature`` whose HBM
+    tier holds the first ``hot_rows`` rows DECODED from the artifact
+    (so hot and disk lookups agree exactly — quantization error lives
+    in the artifact once, not in the tier boundary) and whose disk
+    tier is the artifact's mmap; ``prefetch_rows`` attaches the
+    frontier-keyed cold prefetcher with that ring capacity
+    (``prefetch_kwargs`` forward to ``enable_cold_prefetch``). Returns
+    ``(feature, meta)``; the caller owns ``feature.close()``. Shared by
+    ``benchmarks/bench_feature.py --ab-prefetch``, ``bench.py``'s
+    cold-tier figure and ``scripts/check_leak.py`` phase 8."""
+    from .feature import DeviceConfig, Feature
+
+    kwargs, meta = load_disk_tier(result_path)
+    store = Feature()
+    if hot_rows:
+        mm = np.load(kwargs["path"], mmap_mode="r")
+        if meta["dtype_policy"] == "int8":
+            tier = quant.QuantizedTensor(mm, np.load(kwargs["scale"]),
+                                         np.load(kwargs["zero"]))
+        else:
+            tier = mm
+        hot = np.ascontiguousarray(
+            quant.take_np(tier, np.arange(int(hot_rows))))
+        store.from_mmap(None, DeviceConfig([hot], None))
+    store.set_mmap_file(**kwargs)
+    if prefetch_rows:
+        store.enable_cold_prefetch(prefetch_rows, **prefetch_kwargs)
+    return store, meta

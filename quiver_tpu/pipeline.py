@@ -36,7 +36,7 @@ import threading
 import time
 import weakref
 from concurrent.futures import Future
-from typing import Callable, Iterable, Iterator
+from typing import Callable, Iterable, Iterator, Optional
 
 from . import tracing
 
@@ -123,7 +123,7 @@ class Pipeline:
         # says whether the pipeline depth or the stage itself is the
         # bottleneck (metrics.StepStats.watch_pipeline consumes this)
         self._stats = {"submitted": 0, "completed": 0, "failed": 0,
-                       "cancelled": 0, "max_depth": 0,
+                       "cancelled": 0, "dropped": 0, "max_depth": 0,
                        "total_wait_s": 0.0, "max_wait_s": 0.0}
         self._stats_lock = threading.Lock()
         self._finalizer = weakref.finalize(self, _finalize_shutdown,
@@ -131,7 +131,7 @@ class Pipeline:
                                            self._stats, self._stats_lock)
 
     # -- core ---------------------------------------------------------------
-    def submit(self, fn: Callable, *args, **kwargs) -> Future:
+    def _ensure_worker(self):
         with self._lock:
             if self._closed:
                 raise RuntimeError(f"{self._name}: pipeline is closed")
@@ -142,6 +142,9 @@ class Pipeline:
                                      name=self._name, daemon=True)
                 t.start()
                 self._box["thread"] = t
+
+    def submit(self, fn: Callable, *args, **kwargs) -> Future:
+        self._ensure_worker()
         fut: Future = Future()
         # count the submission BEFORE the (possibly blocking) put: a
         # concurrent stats() read must never see completed > submitted
@@ -160,6 +163,35 @@ class Pipeline:
             # completes normally.
             if fut.cancel():
                 raise RuntimeError(f"{self._name}: pipeline is closed")
+        return fut
+
+    def try_submit(self, fn: Callable, *args, **kwargs) -> Optional[Future]:
+        """Non-blocking :meth:`submit`: returns the ``Future``, or
+        ``None`` when the queue is already at ``depth`` — the item is
+        DROPPED, not queued (counted in ``stats()['dropped']``). The
+        cold-tier prefetcher publishes frontier batches this way: a
+        prefetcher that falls behind must shed publications (the
+        batch's reads fall back to the synchronous path, counted, never
+        wrong) rather than backpressure the sampler."""
+        self._ensure_worker()
+        fut: Future = Future()
+        with self._stats_lock:
+            self._stats["submitted"] += 1
+        try:
+            self._q.put_nowait((fut, fn, args, kwargs,
+                                time.perf_counter()))
+        except queue.Full:
+            with self._stats_lock:
+                self._stats["submitted"] -= 1
+                self._stats["dropped"] += 1
+            return None
+        with self._stats_lock:
+            self._stats["max_depth"] = max(self._stats["max_depth"],
+                                           self._q.qsize())
+        if self._closed:
+            # same close() race as submit(): reclaim a stranded item
+            if fut.cancel():
+                return None
         return fut
 
     def map(self, fn: Callable, items: Iterable) -> Iterator:

@@ -1,0 +1,405 @@
+"""Frontier-ahead asynchronous cold-tier (NVMe/mmap) prefetch.
+
+The storage hierarchy this package optimizes is placement by bandwidth
+— HBM hot set > host-RAM warm tier > disk — and until this module the
+disk rung was a synchronous sidecar: every lookup that crossed into
+``Feature.set_mmap_file``'s mmap tier blocked the step on the read.
+This module makes the disk rung a first-class third tier by overlapping
+its reads with the previous step's compute, keyed on the *sampled
+frontier* (the GIDS/FastSample structure: billion-node training lives
+or dies on hiding storage latency behind compute):
+
+- the sampler side runs **one batch ahead** (``async_sampler.
+  sample_ahead`` on a bounded :class:`~quiver_tpu.pipeline.Pipeline`)
+  and *publishes* each sampled batch's frontier ids the moment the
+  sample completes;
+- a **prefetcher thread** (:class:`ColdPrefetcher`, a second bounded
+  ``Pipeline``) translates the frontier through the store's hot-order
+  permutation, keeps the disk-tier rows, dedups them
+  (``ops.dedup.unique_np`` — one disk read per distinct row, exactly
+  the dedup lever the warm tier already uses), reads the narrow rows
+  (int8 + sidecars) from the mmap and stages them in a **fixed-capacity
+  host staging ring** (:class:`StagingRing`);
+- by the time ``Feature.__getitem__`` / ``lookup_tiered`` needs those
+  rows, the disk read has already overlapped the previous step's
+  compute: ``Feature._read_cold`` consults the ring first and only
+  falls back to the synchronous mmap read for misses — **counted,
+  never wrong** (``metrics.PREFETCH_SYNC_ROWS``). A prefetcher that
+  falls behind *drops* publications (``Pipeline.try_submit``) rather
+  than backpressure the sampler.
+
+Boundedness is structural: the ring is preallocated (capacity x row
+width host bytes, plus a 4 B/row slot index over the mmap's rows), the
+pipeline depth bounds in-flight staging work, and eviction is wrap-
+around overwrite — a long run's memory is constant no matter how many
+batches it publishes (``scripts/check_leak.py`` phase 8 pins it).
+
+Decoded vs raw staging: by default the ring holds *decoded* rows
+(``decode_staged=True``) so the critical-path ``take`` is a pure slice
+copy and the int8 dequant FMA runs on the prefetch thread too — the
+ring then costs logical-width bytes per row. ``decode_staged=False``
+keeps the ring at storage width (4x more rows per byte for int8) and
+pays the dequant at take time. Both are bit-identical to the
+synchronous read (the decode is the same numpy expression
+``code * scale + zero`` either way).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .ops.dedup import unique_np
+
+
+def evict_file_cache(path: str, mapped=None) -> bool:
+    """Drop ``path``'s pages from the OS page cache (best effort,
+    unprivileged). The bigger-than-RAM regime's reads hit storage, not
+    the page cache — a bench on a machine whose whole artifact fits in
+    RAM must evict between steps or it measures memcpy and calls it a
+    disk tier (benchmarks/bench_feature.py --ab-prefetch does; docs/
+    measurements_r12.md shows the warm-cache numbers too).
+
+    ``mapped`` is the live ``np.memmap`` over ``path``, if any:
+    ``fadvise(DONTNEED)`` skips pages still referenced by a mapping's
+    page tables, so the mapping's PTEs are dropped first
+    (``madvise(MADV_DONTNEED)`` — harmless to the mapping, the next
+    access just re-faults). Dirty pages survive DONTNEED too, so a
+    just-written artifact is fsync'd first. Returns False where the
+    platform lacks ``posix_fadvise``."""
+    import mmap as _mmap
+    import os
+    if not hasattr(os, "posix_fadvise"):
+        return False
+    if mapped is not None:
+        base = getattr(mapped, "_mmap", None)
+        if base is not None and hasattr(base, "madvise"):
+            base.madvise(_mmap.MADV_DONTNEED)
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+        os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+    finally:
+        os.close(fd)
+    return True
+
+
+class StagingRing:
+    """Fixed-capacity host staging ring for cold-tier rows.
+
+    ``capacity`` row slots assigned wrap-around (staging past capacity
+    overwrites the oldest slots); a ``[total_rows]`` int32 ``slot_of``
+    index maps mmap row id -> slot (-1 = absent) so ``take`` is one
+    vectorized gather, no per-id Python. All mutation and reads happen
+    under one lock — the staging worker writes while the lookup thread
+    takes — and ``take`` copies the hit rows out under the lock, so a
+    later wrap can never corrupt rows already handed to a caller.
+
+    The 4 B/row ``slot_of`` index scales with the *mmap*, not the ring
+    (a 100M-row tier costs 400 MB of index); a deployment beyond that
+    would swap the dense index for a hash map — out of scope here.
+    """
+
+    def __init__(self, capacity: int, dim: int, dtype, total_rows: int,
+                 sidecar_dtype=None):
+        if capacity < 1:
+            raise ValueError(f"ring capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.rows = np.empty((self.capacity, dim), dtype)
+        self.scale = (None if sidecar_dtype is None
+                      else np.empty((self.capacity, 1), sidecar_dtype))
+        self.zero = (None if sidecar_dtype is None
+                     else np.empty((self.capacity, 1), sidecar_dtype))
+        self.ids = np.full(self.capacity, -1, np.int64)
+        self._slot_of = np.full(int(total_rows), -1, np.int32)
+        self._cursor = 0
+        self._lock = threading.Lock()
+
+    @property
+    def filled(self) -> int:
+        """Occupied slots (bounded by ``capacity`` by construction)."""
+        return int((self.ids >= 0).sum())
+
+    def missing(self, ids: np.ndarray) -> np.ndarray:
+        """The subset of (unique) ``ids`` not currently staged."""
+        with self._lock:
+            return ids[self._slot_of[ids] < 0]
+
+    def stage(self, ids: np.ndarray, rows: np.ndarray, scale=None,
+              zero=None) -> int:
+        """Stage ``rows`` (one per id) into the next slots, evicting
+        whatever the wrap lands on. ``ids`` must be unique and not
+        currently staged (use :meth:`missing`) and at most ``capacity``
+        long — the single staging worker guarantees both."""
+        k = int(ids.shape[0])
+        if not k:
+            return 0
+        if k > self.capacity:
+            raise ValueError(f"staging {k} rows into a {self.capacity}"
+                             "-slot ring (truncate before staging)")
+        with self._lock:
+            slots = (self._cursor + np.arange(k)) % self.capacity
+            evicted = self.ids[slots]
+            self._slot_of[evicted[evicted >= 0]] = -1
+            self.ids[slots] = ids
+            self.rows[slots] = rows
+            if self.scale is not None:
+                self.scale[slots] = scale
+                self.zero[slots] = zero
+            self._slot_of[ids] = slots.astype(np.int32)
+            self._cursor = int((self._cursor + k) % self.capacity)
+        return k
+
+    def take(self, ids: np.ndarray, out=None):
+        """Look up ``ids`` (duplicates fine). Returns ``(hit, rows,
+        scale, zero)``: a ``[n]`` bool hit mask and copies of the
+        staged rows (+ sidecars, raw rings only) for the hit positions,
+        in request order. With ``out`` (an ``[n, dim]`` array of the
+        ring's dtype) the hit rows are written straight into
+        ``out[hit]`` — one copy instead of two on the lookup's critical
+        path — and ``rows`` is returned None."""
+        with self._lock:
+            slots = self._slot_of[ids]
+            hit = slots >= 0
+            hs = slots[hit]
+            if out is not None:
+                out[hit] = self.rows[hs]
+                rows = None
+            else:
+                rows = self.rows[hs]             # fancy index = copy
+            scale = None if self.scale is None else self.scale[hs]
+            zero = None if self.zero is None else self.zero[hs]
+        return hit, rows, scale, zero
+
+
+class ColdPrefetcher:
+    """Frontier-keyed asynchronous reader for a ``Feature``'s mmap
+    disk tier (see module docstring for the architecture).
+
+    Attach via ``Feature.enable_cold_prefetch(capacity_rows)``; publish
+    FUTURE batches' frontier ids with ``Feature.stage_frontier(ids)``
+    (or let ``async_sampler.sample_ahead`` do it); lookups then consult
+    the ring automatically. Thread-safe; ``close()`` drains the
+    in-flight staging task and stops the worker.
+    """
+
+    def __init__(self, feature, capacity_rows: int, depth: int = 2,
+                 decode_staged: bool = True,
+                 wait_inflight: bool = True):
+        if feature.mmap_array is None or feature.disk_map is None:
+            raise ValueError("cold-tier prefetch needs an mmap disk "
+                             "tier (call set_mmap_file first)")
+        from .pipeline import Pipeline
+        self._feature = feature
+        mm = feature.mmap_array
+        self._quantized = feature.disk_scale is not None
+        # the dtype the synchronous read produces (what lookups see)
+        self._out_dtype = (np.dtype(feature.disk_scale.dtype)
+                           if self._quantized else np.dtype(mm.dtype))
+        self.decode_staged = bool(decode_staged)
+        ring_dtype = (self._out_dtype if self.decode_staged
+                      else np.dtype(mm.dtype))
+        sidecar_dtype = (feature.disk_scale.dtype
+                         if self._quantized and not self.decode_staged
+                         else None)
+        self._ring = StagingRing(capacity_rows, mm.shape[1], ring_dtype,
+                                 mm.shape[0], sidecar_dtype)
+        self._pipe = Pipeline(depth=depth, name="quiver-cold-prefetch")
+        # cumulative counters, drained as deltas by the metrics path:
+        # [hit rows, sync-fallback rows, staged rows]
+        self._counters = np.zeros(3, np.int64)
+        self._staged_undrained = 0
+        self._published = 0
+        self._dropped = 0
+        self._batches_staged = 0
+        # wait_inflight: a lookup that misses while a staging task is
+        # STILL RUNNING waits for it and re-takes, instead of re-paying
+        # the disk read synchronously for rows whose read is already in
+        # flight — a late publication then costs the REMAINING staging
+        # time, never a duplicate read. The in-flight set is bounded by
+        # the pipeline depth.
+        self.wait_inflight = bool(wait_inflight)
+        self._inflight: list = []
+        self._lock = threading.Lock()
+
+    # -- publishing ---------------------------------------------------------
+    def publish(self, frontier_ids, block: bool = False):
+        """Publish a FUTURE batch's frontier (logical node ids; -1
+        padding fine; a device array is snapshotted on the worker so
+        publishing never blocks on an in-flight computation). Returns
+        the staging ``Future``, or None when the pipeline is at depth
+        and ``block=False`` — the publication is DROPPED (counted; the
+        batch's reads fall back to the synchronous path, never wrong).
+        """
+        with self._lock:
+            self._published += 1
+        if block:
+            fut = self._pipe.submit(self._stage, frontier_ids)
+        else:
+            fut = self._pipe.try_submit(self._stage, frontier_ids)
+        if fut is None:
+            with self._lock:
+                self._dropped += 1
+        else:
+            with self._lock:
+                self._inflight = [f for f in self._inflight
+                                  if not f.done()] + [fut]
+        return fut
+
+    def _stage(self, frontier_ids) -> int:
+        """Worker-side staging: frontier -> storage rows -> disk-tier
+        rows -> dedup -> read the NEW rows from the mmap -> ring."""
+        import jax
+        f = self._feature
+        ids = np.asarray(jax.device_get(frontier_ids)).astype(
+            np.int64, copy=False).ravel()
+        n_logical = f.size(0)
+        valid = (ids >= 0) & (ids < n_logical)
+        order = f._order_host()
+        t = ids[valid]
+        if order is not None:
+            # clip exactly like the sync lookup path (feature.py): a
+            # disk_map may span MORE rows than the order (size(0) is
+            # the map's length), and an unclipped index would fail the
+            # staging task where the sync read succeeds
+            t = order[np.clip(t, 0, order.shape[0] - 1)]
+        cold = t >= f.cache_rows
+        disk_rows = f._disk_map_host()[t[cold]]
+        uniq = unique_np(disk_rows)
+        new = self._ring.missing(uniq)
+        if new.shape[0] > self._ring.capacity:
+            # a frontier wider than the whole ring: stage the first
+            # capacity rows (staging more would evict rows staged
+            # moments earlier in this same call)
+            new = new[: self._ring.capacity]
+        if not new.shape[0]:
+            return 0
+        rows = np.asarray(f.mmap_array[new])         # THE disk read
+        scale = zero = None
+        if self._quantized:
+            scale = np.asarray(f.disk_scale[new])
+            zero = np.asarray(f.disk_zero[new])
+            if self.decode_staged:
+                rows = rows.astype(scale.dtype) * scale + zero
+                scale = zero = None
+        elif self.decode_staged and rows.dtype != self._ring.rows.dtype:
+            rows = rows.astype(self._ring.rows.dtype)
+        staged = self._ring.stage(new, rows, scale, zero)
+        with self._lock:
+            self._counters[2] += staged
+            self._staged_undrained += staged
+            self._batches_staged += 1
+        return staged
+
+    # -- the lookup-side read -----------------------------------------------
+    def _take_decoded(self, ids: np.ndarray, out: np.ndarray):
+        """Ring take with decode folded in; hit rows land in ``out``."""
+        if self.decode_staged:
+            hit, _, _, _ = self._ring.take(ids, out=out)
+        else:
+            hit, rows, scale, zero = self._ring.take(ids)
+            if self._quantized and rows.size:
+                rows = rows.astype(scale.dtype) * scale + zero
+            out[hit] = rows
+        return hit
+
+    def gather(self, disk_rows: np.ndarray, sync_read) -> np.ndarray:
+        """Serve ``disk_rows`` (mmap row ids, duplicates fine) from the
+        ring where staged. A miss while a staging task is still IN
+        FLIGHT waits for that task and re-takes (the read is already
+        running — re-issuing it synchronously would pay the disk
+        twice); whatever still misses falls back to
+        ``sync_read(miss_rows)`` — today's synchronous mmap read. Hit
+        and sync-fallback row counts accumulate for the metrics path
+        (a waited-for row counts as a hit: it was served from the ring
+        off a prefetched read)."""
+        out = np.empty((disk_rows.shape[0],) + self._ring.rows.shape[1:],
+                       self._out_dtype)
+        hit = self._take_decoded(disk_rows, out)
+        if self.wait_inflight and not hit.all():
+            # ONE snapshot of the stagings in flight at miss time (at
+            # most pipeline-depth futures; later publications are not
+            # waited on — unbounded waiting under a fast publisher)
+            with self._lock:
+                pending = [f for f in self._inflight if not f.done()]
+                self._inflight = pending
+            for fut in pending:
+                if hit.all():
+                    break
+                try:
+                    fut.result()
+                except Exception:   # cancelled/failed staging: go sync
+                    continue
+                miss_pos = np.flatnonzero(~hit)
+                sub = np.empty((miss_pos.shape[0],) + out.shape[1:],
+                               out.dtype)
+                sub_hit = self._take_decoded(disk_rows[miss_pos], sub)
+                out[miss_pos[sub_hit]] = sub[sub_hit]
+                hit = hit.copy()
+                hit[miss_pos[sub_hit]] = True
+        with self._lock:
+            n_hit = int(hit.sum())
+            self._counters[0] += n_hit
+            self._counters[1] += int(hit.shape[0]) - n_hit
+        miss = ~hit
+        if miss.any():
+            out[miss] = sync_read(disk_rows[miss])
+        return out
+
+    # -- telemetry ----------------------------------------------------------
+    def counters(self) -> np.ndarray:
+        """Cumulative ``[hit_rows, sync_rows, staged_rows]`` (int64
+        copy) — the metrics path snapshots this around a lookup and
+        writes the hit/sync delta into the ``PREFETCH_*`` slots."""
+        with self._lock:
+            return self._counters.copy()
+
+    def drain_staged(self) -> int:
+        """Rows staged since the last drain — a batch's publication
+        runs DURING the previous step, so the metrics path attributes
+        everything staged since its last lookup to the current one
+        (``PREFETCH_STAGED_ROWS``, the staged-rows/batch slot)."""
+        with self._lock:
+            staged, self._staged_undrained = self._staged_undrained, 0
+        return staged
+
+    def stats(self) -> dict:
+        """Telemetry snapshot: publication and row counts, the derived
+        hit rate, ring occupancy, and the staging pipeline's stats."""
+        with self._lock:
+            hit, sync, staged = (int(v) for v in self._counters)
+            pub, drop, bat = (self._published, self._dropped,
+                              self._batches_staged)
+        total = hit + sync
+        return {
+            "published": pub, "dropped": drop, "batches_staged": bat,
+            "hit_rows": hit, "sync_rows": sync, "staged_rows": staged,
+            "hit_rate": (hit / total) if total else None,
+            "capacity": self._ring.capacity, "filled": self._ring.filled,
+            "pipeline": self._pipe.stats(),
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self, wait: bool = True):
+        """Stop the staging worker (idempotent). Queued publications
+        are cancelled, the in-flight one finishes, and the worker
+        thread is joined (``wait=True``) — nothing is stranded."""
+        self._pipe.close(wait=wait)
+
+    @property
+    def closed(self) -> bool:
+        return self._pipe.closed
+
+    def __enter__(self) -> "ColdPrefetcher":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __repr__(self):
+        s = self.stats()
+        return (f"ColdPrefetcher(capacity={s['capacity']}, "
+                f"filled={s['filled']}, hit={s['hit_rows']}, "
+                f"sync={s['sync_rows']}, "
+                f"{'closed' if self.closed else 'open'})")

@@ -12,7 +12,10 @@ round order:
   outage, not a regression (``bench.py`` emits the distinguishable
   skip record for exactly this consumer);
 - values are grouped by ``(metric, platform)`` so a ``cpu-smoke`` run
-  is never compared against a TPU number;
+  is never compared against a TPU number; beside the headline
+  ``value``, the auxiliary rate keys in ``SUB_METRICS``
+  (``cold_rows_per_s``, ``prefetch_hit_rate`` — the cold-tier
+  prefetch figures bench.py emits) form their own groups;
 - the verdict judges each group's LATEST non-skipped value against the
   best prior one: more than ``--threshold`` (default 15%) below it is
   a regression — reported and exit code 1 (``chip_suite.sh`` exports
@@ -114,6 +117,29 @@ def is_skipped(rec):
     return bool(rec.get("skipped")) or rec.get("value") is None
 
 
+#: auxiliary per-record rate keys tracked as their OWN (metric,
+#: platform) trajectory groups beside the headline ``value`` — all
+#: higher-is-better (rows/s; the hit rate is a fraction), judged with
+#: the same latest-vs-best-prior rule. Absent keys (older rounds
+#: predate them) simply contribute no point.
+SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate")
+
+
+def _points(rec):
+    """Every (metric name, value) trajectory point one record carries:
+    the headline ``value`` under its ``metric`` string, plus each
+    present ``SUB_METRICS`` key under its own name."""
+    pts = []
+    v = rec.get("value")
+    if isinstance(v, (int, float)):
+        pts.append((rec.get("metric", "?"), v))
+    for sub in SUB_METRICS:
+        sv = rec.get(sub)
+        if isinstance(sv, (int, float)):
+            pts.append((sub, sv))
+    return pts
+
+
 def check(records, threshold):
     """Walk ``[(label, rec)]`` in order; judge each group's LATEST
     value against the best PRIOR one. Returns (regressions, checked)
@@ -124,17 +150,16 @@ def check(records, threshold):
     for label, rec in records:
         if is_skipped(rec):
             continue
-        value = rec.get("value")
-        if not isinstance(value, (int, float)):
-            continue
-        key = (rec.get("metric", "?"), rec.get("platform", ""))
-        checked += 1
-        prev = latest.get(key)
-        if prev is not None:
-            prior = best.get(key)
-            if prior is None or prev[0] > prior[0]:
-                best[key] = prev
-        latest[key] = (value, label)
+        platform = rec.get("platform", "")
+        for metric, value in _points(rec):
+            key = (metric, platform)
+            checked += 1
+            prev = latest.get(key)
+            if prev is not None:
+                prior = best.get(key)
+                if prior is None or prev[0] > prior[0]:
+                    best[key] = prev
+            latest[key] = (value, label)
     regressions = []
     for key, (value, label) in sorted(latest.items()):
         prior = best.get(key)
@@ -175,7 +200,7 @@ def main(argv=None):
         return 0
     skipped = sum(1 for _, r in records if is_skipped(r))
     regressions, checked = check(records, args.threshold)
-    print(f"bench_regress: {checked} measured records "
+    print(f"bench_regress: {checked} measured values "
           f"({skipped} skipped/unavailable rounds ignored), "
           f"threshold {args.threshold:.0%}")
     for r in regressions:

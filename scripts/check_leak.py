@@ -49,6 +49,14 @@ than the span volume so the wrap actually happens, and asserts the
 retained span count never exceeds capacity (bounded memory no matter
 how long the server runs) and that the Perfetto export round-trips.
 
+Phase 8 pins the COLD-TIER PREFETCH path: 50 frontier-ahead prefetched
+disk-tier steps (publish batch i+1, gather batch i, jitted compute) —
+zero executable growth, zero recompiles through the StepStats watch,
+live arrays flat, and the staging ring bounded at its capacity (it is
+sized BELOW the distinct cold rows the loop touches, so the wraparound
+eviction path is what gets pinned — and the ring buffers must be the
+SAME objects at the end: eviction overwrites, never reallocates).
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -537,6 +545,103 @@ def main():
     tracing.clear()
     print("no leak detected (phase 7: traced+metered serving, bounded "
           "span ring)")
+
+    # ---- phase 8: frontier-ahead cold-tier prefetch, bounded ring ----
+    import shutil
+
+    from quiver_tpu.partition import load_disk_tier_store, save_disk_tier
+
+    cn, cdim = 24_000, 32
+    ccache = cn // 2
+    ccap = 2_048          # << the ~16k distinct cold rows below: WRAPS
+    cbatch, ccold = 1_024, 512
+    ctmp = tempfile.mkdtemp(prefix="qt_leak_cold_")
+    cfeat = rng.standard_normal((cn, cdim)).astype(np.float32)
+    save_disk_tier(cfeat, np.arange(cn, dtype=np.int64), ctmp,
+                   dtype_policy="int8")
+    cstore, _cmeta = load_disk_tier_store(ctmp, hot_rows=ccache,
+                                          prefetch_rows=ccap)
+    cpf = cstore._cold_prefetch
+    ring_rows_buf = cpf._ring.rows          # identity pinned below
+    ring_index_buf = cpf._ring._slot_of
+    cw = jnp.asarray(rng.standard_normal((cdim, cdim))
+                     .astype(np.float32))
+    ccompute = jax.jit(lambda x, w: jnp.sum(jnp.tanh(x @ w)))
+    cstats = qm.StepStats(fold_every=8)
+
+    def cold_batch():
+        # CONSTANT cold count per batch so the numpy path's
+        # power-of-two scatter bucket is one compiled shape
+        cold_ids = rng.integers(ccache, cn, ccold)
+        hot_ids = rng.integers(0, ccache, cbatch - ccold)
+        a = np.concatenate([cold_ids, hot_ids])
+        rng.shuffle(a)
+        return a.astype(np.int64)
+
+    def cold_cycle(ids_now, ids_next, publish=True):
+        rows, counters = cstore.lookup_tiered(ids_now,
+                                              collect_metrics=True)
+        if publish:
+            cstore.stage_frontier(ids_next)
+        out = ccompute(rows, cw)
+        jax.block_until_ready(out)
+        cstats.add_counters(counters)
+
+    # warmup: compile gather + compute, settle caches, arm the watch
+    cb = [cold_batch() for _ in range(2)]
+    cstore.stage_frontier(cb[0]).result()
+    cold_cycle(cb[0], cb[1])
+    cold_cycle(cb[1], cb[0])
+    cstats.watch_compiles(cstore._gather_cached, cstore._translate,
+                          ccompute)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = (cstore._gather_cached._cache_size()
+                  + ccompute._cache_size())
+
+    ids_next = cold_batch()
+    cstore.stage_frontier(ids_next).result()
+    for i in range(50):
+        ids_now, ids_next = ids_next, cold_batch()
+        # every 5th publication deliberately skipped: the NEXT batch
+        # then leans on whatever the ring still holds — the sync
+        # fallback path is exercised deterministically, not only when
+        # the staging worker loses a race
+        cold_cycle(ids_now, ids_next, publish=(i % 5 != 4))
+        assert cpf._ring.filled <= ccap, "staging ring exceeded capacity"
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = (cstore._gather_cached._cache_size()
+            + ccompute._cache_size()) - base_cache
+    snap = cstats.snapshot()
+    pstats = cpf.stats()
+    print(f"phase 8 live arrays: {base_arrays} -> {arrays}; "
+          f"prefetched-step executable-cache growth: {grew}; "
+          f"recompiles seen by StepStats: {snap['recompiles']}; "
+          f"ring filled: {pstats['filled']}/{ccap}, staged "
+          f"{pstats['staged_rows']} rows, hit rate "
+          f"{pstats['hit_rate']:.2f}")
+    assert grew == 0, "cold-tier prefetch recompiled mid-loop"
+    assert snap["recompiles"] == 0, \
+        "prefetched compute recompiled mid-loop"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak in the prefetched cold-tier loop"
+    assert cpf._ring.rows is ring_rows_buf \
+        and cpf._ring._slot_of is ring_index_buf, \
+        "staging ring reallocated (eviction must overwrite in place)"
+    assert pstats["filled"] == ccap, \
+        "ring never filled — the wraparound path was not exercised " \
+        "(phase premise: distinct cold rows must exceed capacity)"
+    assert pstats["staged_rows"] > ccap, "ring never wrapped"
+    assert pstats["hit_rows"] > 0 and pstats["sync_rows"] > 0, \
+        "phase premise: the loop must exercise BOTH ring hits and " \
+        "sync fallbacks (capacity < working set)"
+    assert snap["counters"]["prefetch_hit_rows"] == pstats["hit_rows"]
+    cstore.close()
+    assert cpf.closed, "close() left the prefetch worker running"
+    shutil.rmtree(ctmp, ignore_errors=True)
+    print("no leak detected (phase 8: frontier-ahead cold-tier "
+          "prefetch, bounded staging ring)")
 
 
 if __name__ == "__main__":

@@ -70,6 +70,14 @@ def test_bench_cpu_smoke_json_contract(tmp_path):
     assert 0.0 < out["observed_hot_hit_rate"] < 1.0
     assert out["observed_dup_factor"] > 1.5
     assert out["observed_cold_rows_per_batch"] > 0
+    # the disk rung: cold-tier rows/sec through the frontier-ahead
+    # prefetch path + the OBSERVED staging-ring hit rate (every batch
+    # is published one step ahead and the ring is sized generously, so
+    # the rate must be high — and these two keys are what
+    # scripts/bench_regress.py tracks as their own trajectory groups)
+    assert out["cold_rows_per_s"] > 0
+    assert 0.5 < out["prefetch_hit_rate"] <= 1.0
+    assert out["prefetch_staged_rows_per_batch"] > 0
     assert out["vs_baseline"] is None
     assert "error" not in out
     # the same record also landed in the structured metrics log
