@@ -437,9 +437,11 @@ def main():
         from quiver_tpu import metrics as qmetrics
         tc0 = time.perf_counter()
         total_c = None
-        for a in batches_f:
+        counter_vecs = []       # per-batch vectors — the telemetry
+        for a in batches_f:     # hub's advisory replan feeds on these
             _, c = store._lookup_tiered(store.device_part, host, a,
                                         store.feature_order, False, True)
+            counter_vecs.append(c)
             total_c = c if total_c is None else \
                 qmetrics.merge_counters(total_c, c)
         observed = qmetrics.derive(total_c)
@@ -480,12 +482,27 @@ def main():
         compact_bytes = sum(
             compact_exchange_slots(a, cap, exch_hosts) * (4 + row_b)
             for a in batches_f) / len(batches_f)
+        # what the advisory replan needs to compare observation against
+        # the plan: the store's actual hot capacity and the EFFECTIVE
+        # dedup budget its lookups ran with (dedup_cold=True resolves
+        # to the default per-batch budget)
+        from quiver_tpu.ops.quant import default_cold_budget
+        dedup_budget = None
+        if store.dedup_cold:
+            dedup_budget = (int(store.dedup_cold)
+                            if not isinstance(store.dedup_cold, bool)
+                            else default_cold_budget(f_batch))
+        plan_facts = {"hot_capacity": int(store.cache_rows),
+                      "total_rows": f_rows,
+                      "dedup_budget": dedup_budget}
         return (rps, host_bytes / len(batches_f), exch_bytes, cap,
-                compact_bytes, observed, observed_cold_rows)
+                compact_bytes, observed, observed_cold_rows,
+                counter_vecs, plan_facts)
 
     (feature_gather_rps, host_bytes_per_batch, exchange_bytes_per_batch,
      exchange_cap, exchange_compact_bytes_per_batch, observed,
-     observed_cold_rows) = measure_feature_gather()
+     observed_cold_rows, counter_vecs, plan_facts) = \
+        measure_feature_gather()
 
     # ---- cold-tier (disk mmap) figure: the THIRD rung of the
     # hierarchy. A small quantized disk-tier artifact (int8 rows +
@@ -634,8 +651,22 @@ def main():
     if sink_path:
         try:
             from quiver_tpu.metrics import MetricsSink
+            from quiver_tpu.telemetry import PlanContext, TelemetryHub
             with MetricsSink(sink_path) as sink:
                 sink.emit(out, kind="bench")
+                # advisory replan over the OBSERVED per-batch counter
+                # vectors: the hub re-derives the dedup budget / hot
+                # sizing from what the gather pass actually saw and
+                # leaves `advice` records beside the `bench` one —
+                # observe-only, nothing in the run was adjusted
+                hub = TelemetryHub(window=4, sink=sink,
+                                   plan=PlanContext(**plan_facts))
+                for c in counter_vecs:
+                    hub.observe_counters(c)
+                for rec in hub.replan():
+                    print(f"bench advice: {rec['key']} "
+                          f"{rec['current']} -> {rec['recommended']} "
+                          f"({rec['reason']})", file=sys.stderr)
         except Exception as e:          # telemetry must never fail a run
             print(f"metrics sink failed: {e!r}", file=sys.stderr)
 

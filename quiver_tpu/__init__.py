@@ -53,8 +53,9 @@ from .pipeline import Pipeline, pipelined
 from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
                       ServeEngine, build_serve_step)
+from .telemetry import FlightRecorder, PlanContext, TelemetryHub
 from . import (comm, profiling, checkpoint, datasets, debug, metrics,
-               serving, tracing)
+               serving, telemetry, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -119,4 +120,7 @@ __all__ = [
     "ServeConfig",
     "ServeEngine",
     "build_serve_step",
+    "TelemetryHub",
+    "PlanContext",
+    "FlightRecorder",
 ]

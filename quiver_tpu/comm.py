@@ -318,7 +318,8 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
                          batch_per_host: int, dtype=None,
                          with_replicate: bool = False,
                          exchange_cap: Optional[int] = None,
-                         collect_metrics: bool = False):
+                         collect_metrics: bool = False,
+                         merge_counters: bool = False):
     """The WHOLE DistFeature lookup as one jitted SPMD program
     (reference feature.py:555-567 dispatch + comm.py:127-182 exchange +
     scatter, fused):
@@ -351,8 +352,20 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
     ``[H, metrics.NUM_COUNTERS]`` int32 device counter block (fallback
     flag, peak bucket load vs cap, dedup statistics) — pure jnp
     accumulation, no host sync, rows bit-identical either way.
+
+    ``merge_counters=True`` (requires ``collect_metrics``) folds that
+    block over ``axis`` ON DEVICE before it leaves the program
+    (``metrics.pmerge_counters`` — psum add slots, pmax max slots) and
+    returns ONE replicated ``[metrics.NUM_COUNTERS]`` vector instead of
+    the per-shard block: on a real multi-host mesh, where each process
+    can only address its own shard of a ``P(axis)`` output, every
+    host then observes the GLOBAL hit/fallback/dup picture. Two extra
+    int32-vector collectives per lookup; rows bit-identical either way.
     """
     h_count = mesh.shape[axis]
+    if merge_counters and not collect_metrics:
+        raise ValueError("merge_counters=True requires "
+                         "collect_metrics=True")
 
     def body(ids, g2h, loc, feat, *rep):
         col = None
@@ -365,16 +378,25 @@ def build_dist_lookup_fn(mesh: Mesh, axis: str, rows_per_host: int,
                                 exchange_cap=exchange_cap,
                                 collector=col)
         if collect_metrics:
+            if merge_counters:
+                from .metrics import pmerge_counters
+                return out, pmerge_counters(col.counters(), axis)
             return out, col.counters()[None]
         return out
 
     specs = (P(axis), P(), P(), P(axis))
     if with_replicate:
         specs += (P(), P(), P())
+    if collect_metrics:
+        # merged counters are replicated (every shard holds the global
+        # vector after the psum/pmax), so they leave unsharded
+        outs = (P(axis), P()) if merge_counters else (P(axis), P(axis))
+    else:
+        outs = P(axis)
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=specs,
-        out_specs=(P(axis), P(axis)) if collect_metrics else P(axis),
+        out_specs=outs,
         check_vma=False)
     return jax.jit(mapped)
 

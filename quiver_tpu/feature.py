@@ -1172,7 +1172,7 @@ class DistFeature:
 
     def __init__(self, feature: Optional[Feature], info: PartitionInfo,
                  comm, dedup_cold=False, exchange_cap=None,
-                 collect_metrics=False):
+                 collect_metrics=False, merge_counters=False):
         self.feature = feature
         self.info = info
         self.comm = comm
@@ -1201,6 +1201,16 @@ class DistFeature:
         # read it lazily (metrics.StepStats.add_counters) to keep the
         # lookup sync-free. Rows are bit-identical either way.
         self.collect_metrics = bool(collect_metrics)
+        # merge_counters: fold the per-shard block over the host axis
+        # ON DEVICE before it leaves the lookup (psum add slots, pmax
+        # max slots) — ``last_counters`` is then ONE global [N] vector
+        # every host can read, instead of a [H, N] block of which a
+        # real multi-host process only addresses its own row. Requires
+        # collect_metrics.
+        self.merge_counters = bool(merge_counters)
+        if self.merge_counters and not self.collect_metrics:
+            raise ValueError("merge_counters=True requires "
+                             "collect_metrics=True")
         self.last_counters = None
         self._spmd_feat = None         # [H*rows_per_host, dim], P(axis)
         self._rows_per_host = None
@@ -1212,7 +1222,8 @@ class DistFeature:
                        dtype=None, dedup_cold=False,
                        dtype_policy=None,
                        exchange_cap=None,
-                       collect_metrics=False) -> "DistFeature":
+                       collect_metrics=False,
+                       merge_counters=False) -> "DistFeature":
         """Build the SPMD store from the FULL feature array + partition
         metadata: each host's rows land in its shard (replicated nodes
         also in every host's tail), row-sharded over ``comm.mesh``.
@@ -1226,7 +1237,10 @@ class DistFeature:
         block (see ``__init__``) — the two knobs multiply: narrow rows
         x one crossing per distinct remote row. ``collect_metrics=True``
         makes every lookup also emit the device counter block (see
-        ``__init__``; stashed on ``last_counters``).
+        ``__init__``; stashed on ``last_counters``);
+        ``merge_counters=True`` folds it over the host axis on device
+        so ``last_counters`` is the GLOBAL [N] vector on every host
+        (see ``__init__``).
         """
         if comm.mesh is None:
             raise ValueError("from_partition needs a comm with a mesh")
@@ -1251,7 +1265,8 @@ class DistFeature:
         sharding = NamedSharding(comm.mesh, P(axis))
         self = cls(None, info, comm, dedup_cold=dedup_cold,
                    exchange_cap=exchange_cap,
-                   collect_metrics=collect_metrics)
+                   collect_metrics=collect_metrics,
+                   merge_counters=merge_counters)
         self._spmd_feat = quant.tree_map_tier(
             lambda a: jax.device_put(a, sharding),
             quant.quantize(store.reshape(hosts * rows_per_host, dim),
@@ -1331,8 +1346,9 @@ class DistFeature:
         # dtype passed EXPLICITLY from the store's payload (a bf16 or
         # quantized store must never silently upcast to an fp32 default)
         collect = self.collect_metrics
+        merge = self.merge_counters
         key = (b, quant.tier_key(self._spmd_feat),
-               self._rep_args is not None, cap, collect)
+               self._rep_args is not None, cap, collect, merge)
         fn = self._lookup_fns.get(key)
         if fn is None:
             from .comm import build_dist_lookup_fn
@@ -1340,7 +1356,8 @@ class DistFeature:
                 self.comm.mesh, self.comm.axis, self._rows_per_host, b,
                 quant.tier_dtype(self._spmd_feat),
                 with_replicate=self._rep_args is not None,
-                exchange_cap=cap, collect_metrics=collect)
+                exchange_cap=cap, collect_metrics=collect,
+                merge_counters=merge)
             self._lookup_fns[key] = fn
         args = (ids, self.info.global2host.astype(jnp.int32),
                 self.info.global2local, self._spmd_feat)

@@ -40,11 +40,14 @@ records), ``canary`` (benchmarks/canary.py's usability probe),
 payload whose ``wall`` block times BATCH dispatches, plus a ``request``
 block with per-REQUEST admission->result latency percentiles and a
 ``serving`` block with admission/shed/variant-mix counts), ``slo``
-(:class:`SloBudget.snapshot` — error-budget burn rates), and
+(:class:`SloBudget.snapshot` — error-budget burn rates),
 ``scope_timer`` (``profiling.ScopeTimer.emit`` — accumulated wall-clock
-stage timings). Consumers key on ``kind`` and must ignore unknown
-fields; ``scripts/lint.sh`` pins that every kind and every counter slot
-has a row in docs/observability.md.
+stage timings), ``anomaly`` / ``advice``
+(``telemetry.TelemetryHub`` — change-point detections and advisory
+re-planning records), and ``regress`` (``scripts/bench_regress.py`` —
+per-trajectory-group verdicts). Consumers key on ``kind`` and must
+ignore unknown fields; ``scripts/lint.sh`` pins that every kind and
+every counter slot has a row in docs/observability.md.
 """
 
 from __future__ import annotations
@@ -160,6 +163,39 @@ def merge_counters(a, b):
     a = jnp.asarray(a)
     b = jnp.asarray(b)
     return jnp.where(jnp.asarray(_MAX_MASK_NP), jnp.maximum(a, b), a + b)
+
+
+def pmerge_counters(vec, axis: str):
+    """DEVICE-side cross-shard merge of a counter vector, callable only
+    inside a ``shard_map``/``pmap`` over ``axis``: ``psum`` on additive
+    slots, ``pmax`` on ``MAX_SLOTS`` — the same semantics as
+    :func:`merge_counters`, applied over the mesh axis. This is how the
+    dist builders' ``merge_counters=True`` makes every host's
+    ``last_counters`` the GLOBAL picture on a real multi-host mesh
+    (where the per-shard ``[H, N]`` output is otherwise only locally
+    addressable). Pure collectives on an int32 vector: no host sync, no
+    effect on the loss path."""
+    summed = jax.lax.psum(vec, axis)
+    peaked = jax.lax.pmax(vec, axis)
+    return jnp.where(jnp.asarray(_MAX_MASK_NP), peaked, summed)
+
+
+def merge_named_counters(a: Dict[str, int], b: Dict[str, int]) -> Dict[str, int]:
+    """Merge two NAMED counter dicts (``counters_dict`` payloads, e.g.
+    from per-host JSONL ``step_stats`` records) with the slot
+    semantics: add, except the ``MAX_SLOTS`` names which take max.
+    Unknown keys add (forward-compatible with new slots)."""
+    max_names = {SLOT_NAMES[s] for s in MAX_SLOTS}
+    out = dict(a)
+    for k, v in b.items():
+        if v is None:
+            continue
+        cur = out.get(k)
+        if cur is None:
+            out[k] = v
+        else:
+            out[k] = max(cur, v) if k in max_names else cur + v
+    return out
 
 
 def reduce_counters(stack) -> np.ndarray:
@@ -618,12 +654,25 @@ class MetricsSink:
 
     ``path`` is a filesystem path (opened append) or any file-like with
     ``write``. Every record gains ``ts`` (unix seconds) and ``kind``.
+
+    ``max_bytes`` (path-owned sinks only) bounds the file: when an emit
+    pushes it past the limit, the file rolls over to ``<path>.1``
+    (replacing any previous rollover) and a fresh file starts — a
+    week-long chip_watch keeps at most ``2 * max_bytes`` on disk
+    instead of growing without bound. Readers that want the full
+    window read the seam: :func:`read_jsonl` (and ``scripts/qt_top.py``
+    / ``scripts/bench_regress.py``) consume ``<path>.1`` before
+    ``<path>``.
     """
 
-    def __init__(self, path, kind: str = "record"):
+    def __init__(self, path, kind: str = "record",
+                 max_bytes: Optional[int] = None):
         self._own = isinstance(path, (str, bytes, os.PathLike))
+        self._path = os.fspath(path) if self._own else None
         self._f = open(path, "a") if self._own else path
         self._kind = kind
+        self._max_bytes = (int(max_bytes)
+                           if max_bytes and self._own else None)
         self._lock = threading.Lock()
 
     def emit(self, record: dict, kind: Optional[str] = None) -> dict:
@@ -634,7 +683,16 @@ class MetricsSink:
         with self._lock:
             self._f.write(line + "\n")
             self._f.flush()
+            if self._max_bytes and self._f.tell() >= self._max_bytes:
+                self._rollover_locked()
         return rec
+
+    def _rollover_locked(self) -> None:
+        # whole-record boundary by construction: rollover happens only
+        # between emits, so neither file ever holds a torn JSON line
+        self._f.close()
+        os.replace(self._path, self._path + ".1")
+        self._f = open(self._path, "a")
 
     def emit_stats(self, stats: StepStats, kind: str = "step_stats") -> dict:
         return self.emit(stats.snapshot(), kind=kind)
@@ -650,10 +708,56 @@ class MetricsSink:
         self.close()
 
 
+def read_jsonl(path) -> List[dict]:
+    """Read a sink's records across the rollover seam: ``<path>.1``
+    (the rolled-over older half, when present) then ``<path>`` —
+    chronological by construction. Unparseable lines are skipped (a
+    crashed writer's torn last line must not poison the history)."""
+    path = os.fspath(path)
+    out: List[dict] = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    return out
+
+
 # -- interactive convenience ------------------------------------------------
 
 _default_stats: Optional[StepStats] = None
 _default_lock = threading.Lock()
+
+# the unified report()'s extra sections: components (a MicroBatchServer,
+# a telemetry.TelemetryHub) register a zero-arg renderer under a name;
+# report() appends each section after the default StepStats block, so
+# ONE call shows counters + step/request stats + SLO + prefetch +
+# tracer status + latest advice without the caller knowing which
+# object owns which block. Registration replaces by name; components
+# unregister on close.
+_report_sections: "collections.OrderedDict[str, object]" = \
+    collections.OrderedDict()
+
+
+def register_report_section(name: str, fn) -> None:
+    """Register a zero-arg ``fn() -> str`` rendered by :func:`report`
+    (after the default ``StepStats`` block). Same ``name`` replaces."""
+    with _default_lock:
+        _report_sections[name] = fn
+
+
+def unregister_report_section(name: str) -> None:
+    with _default_lock:
+        _report_sections.pop(name, None)
 
 
 def stats() -> StepStats:
@@ -667,15 +771,33 @@ def stats() -> StepStats:
 
 
 def report(obj=None) -> str:
-    """Render a telemetry summary: a :class:`StepStats` (default: the
-    process-default one), or a raw counter vector/stack."""
-    if obj is None:
-        obj = stats()
-    if isinstance(obj, StepStats):
-        return obj.report()
-    c = reduce_counters(obj)
-    d = derive(c)
-    named = counters_dict(c)
-    parts = [f"{k}={v}" for k, v in named.items() if v]
-    parts += [f"{k}={v:.3f}" for k, v in d.items() if v is not None]
-    return "counters: " + (", ".join(parts) if parts else "(empty)")
+    """Render a telemetry summary: a :class:`StepStats`, or a raw
+    counter vector/stack. With no argument, the UNIFIED report: the
+    process-default stats (counters + step/request percentiles +
+    prefetch lines), the tracer's status, and every registered section
+    (a live server's serving/SLO block, a ``TelemetryHub``'s series +
+    anomalies + latest advice) — one call, everything observable."""
+    if obj is not None:
+        if isinstance(obj, StepStats):
+            return obj.report()
+        c = reduce_counters(obj)
+        d = derive(c)
+        named = counters_dict(c)
+        parts = [f"{k}={v}" for k, v in named.items() if v]
+        parts += [f"{k}={v:.3f}" for k, v in d.items() if v is not None]
+        return "counters: " + (", ".join(parts) if parts else "(empty)")
+    lines = [stats().report()]
+    from . import tracing
+    tr = tracing.get_tracer()
+    lines.append(f"tracing: {'on' if tr.enabled else 'off'} "
+                 f"({len(tr)}/{tr.capacity} spans retained)")
+    with _default_lock:
+        sections = list(_report_sections.items())
+    for name, fn in sections:
+        try:
+            text = fn()
+        except Exception as e:      # a dead component must not kill
+            text = f"{name}: <report failed: {e!r}>"   # the whole view
+        if text:
+            lines.append(text)
+    return "\n".join(lines)

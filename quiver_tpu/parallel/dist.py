@@ -48,7 +48,8 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
                           hub_frac: float | None = None,
                           donate: bool = True,
                           exchange_cap=None,
-                          collect_metrics: bool = False):
+                          collect_metrics: bool = False,
+                          merge_counters: bool = False):
     """fn(state, spmd_feat, g2h, g2l, indptr, indices, seeds, labels,
     key[, indices_rows][, is_rep, rep_rank, bases]) -> (state, loss).
 
@@ -85,6 +86,9 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
     """
     sizes = list(sizes)
     h_count = mesh.shape[axis]
+    if merge_counters and not collect_metrics:
+        raise ValueError("merge_counters=True requires "
+                         "collect_metrics=True")
     if exchange_cap is True:
         frontier = layer_shapes(per_host_batch, sizes)[-1].n_id_cap
         exchange_cap = default_exchange_cap(frontier, h_count)
@@ -125,6 +129,14 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
             loss, counters, grads = unpack(loss_of(state.params))
             new_state, loss = _pmean_update(state, tx, grads, loss, axis)
             if collect_metrics:
+                if merge_counters:
+                    # device-side cross-host fold: every shard leaves
+                    # holding the GLOBAL [N] vector (psum/pmax slot
+                    # semantics), so any host's local read sees the
+                    # whole mesh's picture
+                    from ..metrics import pmerge_counters
+                    return new_state, loss, pmerge_counters(counters,
+                                                            axis)
                 # per-shard counters, [1, N] here -> [H, N] outside
                 return new_state, loss, counters[None]
             return new_state, loss
@@ -137,11 +149,14 @@ def build_dist_train_step(model, tx, sizes: Sequence[int],
             specs.append(P())            # indices_rows, replicated
         if with_replicate:
             specs += [P(), P(), P()]     # is_rep, rep_rank, bases
+        if collect_metrics:
+            outs = (P(), P(), P() if merge_counters else P(axis))
+        else:
+            outs = (P(), P())
         return jax.jit(shard_map(
             make_per_shard(has_rows), mesh=mesh,
             in_specs=tuple(specs),
-            out_specs=(P(), P(), P(axis)) if collect_metrics
-            else (P(), P()),
+            out_specs=outs,
             check_vma=False), donate_argnums=(0,) if donate else ())
 
     jitted_by_rows = {True: make_jitted(True), False: make_jitted(False)}

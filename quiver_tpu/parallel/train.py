@@ -259,9 +259,17 @@ _COLLECT_DOC = """
     Counters accumulate with pure jnp ops on values the hot path
     already computes: zero host syncs per step, ``lax.cond``
     predicates untouched, losses bit-identical to the metrics-off step,
-    donation intact. Feed the vectors to ``metrics.StepStats``. The
-    returned step exposes ``.jitted_fns`` (the underlying jitted
-    callables) for ``StepStats.watch_compiles``."""
+    donation intact. Feed the vectors to ``metrics.StepStats`` or a
+    ``telemetry.TelemetryHub``. The returned step exposes
+    ``.jitted_fns`` (the underlying jitted callables) for
+    ``StepStats.watch_compiles``. Shard_map builders additionally take
+    ``merge_counters=True``: the per-shard block is folded over the
+    mesh axis ON DEVICE (``metrics.pmerge_counters`` — psum add slots,
+    pmax max slots) and the step returns one replicated global ``[N]``
+    vector — on a real multi-host mesh each process can only address
+    its own shard of the per-shard output, so this is how every host
+    observes the global picture. Losses stay bit-identical with the
+    merge on or off."""
 
 
 def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
@@ -333,7 +341,8 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                          hub_frac: float | None = None,
                          donate: bool = True,
                          dedup_gather=None,
-                         collect_metrics: bool = False):
+                         collect_metrics: bool = False,
+                         merge_counters: bool = False):
     """Data-parallel fused step over ``mesh[axis]``:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]) with seeds/labels [n_dev * per_device_batch] sharded
@@ -349,6 +358,9 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     over its leaves as a pytree prefix."""
     sizes = list(sizes)
     gather = _dedup_gather_fn(dedup_gather)
+    if merge_counters and not collect_metrics:
+        raise ValueError("merge_counters=True requires "
+                         "collect_metrics=True")
 
     def per_shard(state: TrainState, feat, forder, indptr, indices, seeds,
                   labels, key, indices_rows=None):
@@ -364,12 +376,20 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
         loss, counters, grads = unpack(loss_of(state.params))
         new_state, loss = _pmean_update(state, tx, grads, loss, axis)
         if collect_metrics:
+            if merge_counters:
+                # device-side cross-shard fold (psum/pmax slot
+                # semantics): the step emits ONE global [N] vector
+                from ..metrics import pmerge_counters
+                return new_state, loss, pmerge_counters(counters, axis)
             # per-shard counters, [1, N] here -> [n_dev, N] outside
             return new_state, loss, counters[None]
         return new_state, loss
 
     specs = [P(), P(), P(), P(), P(), P(axis), P(axis), P()]
-    outs = (P(), P(), P(axis)) if collect_metrics else (P(), P())
+    if collect_metrics:
+        outs = (P(), P(), P() if merge_counters else P(axis))
+    else:
+        outs = (P(), P())
     # shard_map arity is fixed at build time, but exact may or may not
     # bring the (optional) wide-path rows view — build both arities; jit
     # compiles lazily so the unused one costs nothing

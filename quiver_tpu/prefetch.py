@@ -221,6 +221,10 @@ class ColdPrefetcher:
         # the pipeline depth.
         self.wait_inflight = bool(wait_inflight)
         self._inflight: list = []
+        # observe_into's last-seen cumulative counts, so repeated calls
+        # feed the telemetry hub INTERVAL deltas (per-window hit rate),
+        # not an ever-flattening lifetime average
+        self._hub_last = np.zeros(5, np.int64)
         self._lock = threading.Lock()
 
     # -- publishing ---------------------------------------------------------
@@ -354,6 +358,29 @@ class ColdPrefetcher:
         writes the hit/sync delta into the ``PREFETCH_*`` slots."""
         with self._lock:
             return self._counters.copy()
+
+    def observe_into(self, hub) -> dict:
+        """Feed a ``telemetry.TelemetryHub`` the since-last-call DELTAS
+        of this prefetcher's signals: ``prefetch_hit_rate`` (hits over
+        hits+syncs in the interval — the series the hub's drop detector
+        watches), ``prefetch_staged_rows``, and
+        ``prefetch_drop_rate`` (publications dropped at a saturated
+        staging pipeline). Call it wherever the loop already takes a
+        breath (per epoch, per report); returns the delta dict."""
+        with self._lock:
+            now = np.array([*(int(v) for v in self._counters),
+                            self._published, self._dropped], np.int64)
+            d = now - self._hub_last
+            self._hub_last = now
+        hit, sync, staged, pub, drop = (int(v) for v in d)
+        out = {"hit_rows": hit, "sync_rows": sync, "staged_rows": staged,
+               "published": pub, "dropped": drop}
+        if hit + sync:
+            hub.observe("prefetch_hit_rate", hit / (hit + sync))
+        hub.observe("prefetch_staged_rows", staged)
+        if pub:
+            hub.observe("prefetch_drop_rate", drop / pub)
+        return out
 
     def drain_staged(self) -> int:
         """Rows staged since the last drain — a batch's publication

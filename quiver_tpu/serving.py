@@ -423,13 +423,20 @@ class MicroBatchServer:
 
     def __init__(self, engine: ServeEngine,
                  config: Optional[ServeConfig] = None,
-                 stats=None, start: bool = True):
-        from .metrics import SloBudget, StepStats
+                 stats=None, start: bool = True, hub=None):
+        from .metrics import SloBudget, StepStats, register_report_section
         from .pipeline import Pipeline
         self.engine = engine
         self.config = config or ServeConfig()
         self.stats = stats if stats is not None else StepStats()
         self.stats.watch_compiles(*engine.jitted_fns)
+        # hub: a telemetry.TelemetryHub fed per-BATCH series points
+        # (fill, dispatch ms, shed level) plus the device counter
+        # vectors when the engine collects them — the time-series the
+        # batch_cap/max_wait advisor sizes from. Host-side appends on
+        # the executor thread; the dispatch path is untouched.
+        self.hub = hub
+        self._report_name = f"serving@{id(self):x}"
         cfg = self.config
         # the SLO budget is the shed policy's latency signal (burn
         # rates, not raw p99 samples) AND the `slo` JSONL payload;
@@ -458,6 +465,12 @@ class MicroBatchServer:
             "variant_batches": [0] * len(engine.variants),
         }
         self._counts_lock = threading.Lock()
+        # register into the unified qt.metrics.report() LAST — a
+        # constructor that raises above must not leak a permanently
+        # broken section (close(), which unregisters, is unreachable
+        # on a half-built server); unique name so parallel servers
+        # coexist
+        register_report_section(self._report_name, self.report)
         if start:
             self.start()
 
@@ -478,6 +491,8 @@ class MicroBatchServer:
         """Reject new submissions, fail queued (never-dispatched)
         requests with ``RuntimeError``, drain the in-flight batches,
         stop the coalescer and the pipeline. Idempotent."""
+        from .metrics import unregister_report_section
+        unregister_report_section(self._report_name)
         with self._lock:
             if self._closed:
                 return
@@ -725,6 +740,16 @@ class MicroBatchServer:
         counters = (self.engine.last_counters
                     if self.engine.collect_metrics else None)
         self.stats.record_step(done - t0, counters)
+        if self.hub is not None:
+            # per-batch series for the telemetry hub's detectors and
+            # the serving advisor (batch_cap from observed fill,
+            # max_wait from observed latency); counters ride the hub's
+            # own lazy fold — still no sync on the dispatch path
+            self.hub.observe("serve_batch_fill", len(slots))
+            self.hub.observe("serve_batch_ms", 1e3 * (done - t0))
+            self.hub.observe("serve_shed_level", variant)
+            if counters is not None:
+                self.hub.observe_counters(counters)
         # stats and counts land BEFORE the futures resolve: a client
         # woken by result() may immediately snapshot(), and must see
         # its own batch counted

@@ -36,11 +36,19 @@ what this sweep measured, against everything before it.
 
 Values are rates (edges/s, requests/s, rows/s) — higher is better.
 
+Beside the stdout report and the exit code, the verdict is also
+emitted as ``regress`` JSONL records (one per judged group: metric,
+platform, latest, best, ratio, regressed) appended to ``--emit-jsonl``
+(default: the ``--jsonl`` history when one is in use) — the
+machine-readable trajectory-health feed ``scripts/qt_top.py`` and the
+telemetry hub surface. The exit-code contract is unchanged.
+
 Stdlib only (no jax import): the sentinel must run instantly anywhere,
 including as the last step of an on-chip sweep and inside tier-1 tests.
 
 Usage: python scripts/bench_regress.py [--threshold 0.15]
            [--bench-dir DIR] [--jsonl PATH] [--since EPOCH]
+           [--emit-jsonl PATH]
 """
 
 import argparse
@@ -90,25 +98,45 @@ def load_jsonl(path, since=None):
     """``[(label, record)]`` from a shared-schema metrics JSONL file —
     only ``kind: bench`` measurement records (other kinds — step_stats,
     serving, slo, canary... — are not trajectory points), and only
-    those with ``ts >= since`` when a scope is given."""
+    those with ``ts >= since`` when a scope is given. Reads across the
+    ``MetricsSink`` rollover seam: the rolled-over ``<path>.1`` (older
+    half) is consumed before ``<path>``, so a size-bounded sink loses
+    no trajectory points at the seam."""
     out = []
-    if not path or not os.path.exists(path):
+    if not path:
         return out
-    with open(path) as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("kind") != "bench" or "metric" not in rec:
-                continue
-            if since is not None and rec.get("ts", 0) < since:
-                continue
-            out.append((f"{os.path.basename(path)}:{i + 1}", rec))
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("kind") != "bench" or "metric" not in rec:
+                    continue
+                if since is not None and rec.get("ts", 0) < since:
+                    continue
+                out.append((f"{os.path.basename(p)}:{i + 1}", rec))
     return out
+
+
+def emit_verdicts(path, records, kind="regress"):
+    """Append one ``regress`` JSONL record per judged trajectory group
+    (metric, platform, latest, best, ratio, regressed) plus the overall
+    verdict — the machine-readable mirror of the stdout report, so the
+    telemetry hub / ``qt_top.py`` can surface trajectory health without
+    scraping text. Hand-rolled append (this script must stay jax-free);
+    same ``{ts, kind, ...}`` schema as ``metrics.MetricsSink``."""
+    import time
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps({"ts": round(time.time(), 3),
+                                "kind": kind, **rec}) + "\n")
 
 
 def is_skipped(rec):
@@ -140,10 +168,10 @@ def _points(rec):
     return pts
 
 
-def check(records, threshold):
-    """Walk ``[(label, rec)]`` in order; judge each group's LATEST
-    value against the best PRIOR one. Returns (regressions, checked)
-    where each regression is a dict naming the drop."""
+def _walk(records):
+    """Fold ``[(label, rec)]`` in order into per-(metric, platform)
+    group state: (best-prior (value, label), latest (value, label),
+    points counted)."""
     best = {}          # (metric, platform) -> (value, label)
     latest = {}        # (metric, platform) -> (value, label)
     checked = 0
@@ -160,16 +188,44 @@ def check(records, threshold):
                 if prior is None or prev[0] > prior[0]:
                     best[key] = prev
             latest[key] = (value, label)
-    regressions = []
+    return best, latest, checked
+
+
+def verdicts(records, threshold):
+    """One verdict dict per trajectory group — the LATEST value vs the
+    best PRIOR one, the ratio, and whether it regressed past
+    ``threshold`` (the payload both the stdout report and the
+    ``regress`` JSONL records render) — plus the measured-point count.
+    Returns ``(groups, checked)``; ONE walk of the history serves
+    every consumer."""
+    best, latest, checked = _walk(records)
+    out = []
     for key, (value, label) in sorted(latest.items()):
         prior = best.get(key)
-        if prior is not None and value < (1.0 - threshold) * prior[0]:
-            regressions.append({
-                "metric": key[0], "platform": key[1] or "default",
-                "value": value, "best": prior[0],
-                "best_run": prior[1], "run": label,
-                "drop_frac": 1.0 - value / prior[0],
-            })
+        v = {
+            "metric": key[0], "platform": key[1] or "default",
+            "value": value, "run": label,
+            "best": prior[0] if prior else None,
+            "best_run": prior[1] if prior else None,
+            "ratio": (value / prior[0] if prior and prior[0] else None),
+            "regressed": bool(prior
+                              and value < (1.0 - threshold) * prior[0]),
+        }
+        if prior:
+            v["drop_frac"] = 1.0 - value / prior[0]
+        out.append(v)
+    return out, checked
+
+
+def check(records, threshold):
+    """Walk ``[(label, rec)]`` in order; judge each group's LATEST
+    value against the best PRIOR one. Returns (regressions, checked)
+    where each regression is a dict naming the drop."""
+    groups, checked = verdicts(records, threshold)
+    regressions = [
+        {k: v[k] for k in ("metric", "platform", "value", "best",
+                           "best_run", "run", "drop_frac")}
+        for v in groups if v["regressed"]]
     return regressions, checked
 
 
@@ -190,6 +246,12 @@ def main(argv=None):
                          "(chip_suite.sh passes its start time so the "
                          "verdict judges this sweep's records, not "
                          "stale history)")
+    ap.add_argument("--emit-jsonl", default=None, metavar="PATH",
+                    help="append one `regress` JSONL record per judged "
+                         "group to PATH (default: the --jsonl history "
+                         "when one is in use), so the dashboard/hub "
+                         "can surface trajectory health; the exit code "
+                         "is unchanged")
     args = ap.parse_args(argv)
 
     records = (load_trajectory(args.bench_dir)
@@ -199,7 +261,8 @@ def main(argv=None):
               "nothing to check")
         return 0
     skipped = sum(1 for _, r in records if is_skipped(r))
-    regressions, checked = check(records, args.threshold)
+    groups, checked = verdicts(records, args.threshold)
+    regressions = [v for v in groups if v["regressed"]]
     print(f"bench_regress: {checked} measured values "
           f"({skipped} skipped/unavailable rounds ignored), "
           f"threshold {args.threshold:.0%}")
@@ -207,6 +270,13 @@ def main(argv=None):
         print(f"REGRESSION {r['metric']} [{r['platform']}]: "
               f"{r['value']:.1f} in {r['run']} is {r['drop_frac']:.1%} "
               f"below best {r['best']:.1f} ({r['best_run']})")
+    emit_path = args.emit_jsonl or args.jsonl
+    if emit_path:
+        try:
+            emit_verdicts(emit_path, groups)
+        except OSError as e:            # the verdict must still print
+            print(f"WARN could not append regress records to "
+                  f"{emit_path}: {e}")
     if regressions:
         return 1
     print("bench_regress: trajectory clean")

@@ -57,6 +57,16 @@ sized BELOW the distinct cold rows the loop touches, so the wraparound
 eviction path is what gets pinned — and the ring buffers must be the
 SAME objects at the end: eviction overwrites, never reallocates).
 
+Phase 9 pins the TELEMETRY HUB: 50 metered lookups + donated metered
+train steps with a ``telemetry.TelemetryHub`` fully live — change-point
+detectors armed, the advisory re-planner running every 10 steps, a
+size-bounded ``MetricsSink`` receiving anomaly/advice records. The hub
+is host-side and lazy-folding, so it must add zero executables and
+zero recompiles; its per-metric series rings are sized BELOW the step
+count so the wrap is exercised (bounded memory for week-long runs),
+and the dedup-budget advisor must actually fire (the loop's unique
+counts overflow the store's budget — observed, not synthetic).
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -642,6 +652,99 @@ def main():
     shutil.rmtree(ctmp, ignore_errors=True)
     print("no leak detected (phase 8: frontier-ahead cold-tier "
           "prefetch, bounded staging ring)")
+
+    # ---- phase 9: telemetry hub + detectors + advisor live ----
+    # The observe/decide layer must be free: lazy counter folds, ring
+    # series, detectors and the advisory re-planner add zero
+    # executables, zero recompiles, bounded arrays — and the series
+    # rings are sized BELOW the step count so their wraparound (the
+    # week-long-run memory bound) is what gets pinned.
+    from quiver_tpu.telemetry import PlanContext, TelemetryHub
+
+    RING = 32                 # < 50 loop steps => every series WRAPS
+    hub_budget = 256          # the store's dedup budget — the loop's
+    #                           ~500-unique batches OVERFLOW it, so the
+    #                           advisor has a real shortfall to size
+    hstore = qv.Feature(device_cache_size=n // 4 * dim * 4, csr_topo=topo,
+                        dedup_cold=True, cold_budget=hub_budget)
+    hstore.from_cpu_tensor(feat)
+    hhost = jnp.asarray(hstore.host_part)
+    hub_sink_path = os.path.join(tempfile.mkdtemp(), "hub.jsonl")
+    hub_sink = qm.MetricsSink(hub_sink_path, max_bytes=256_000)
+    hub = TelemetryHub(capacity=RING, window=4, fold_every=8,
+                       sink=hub_sink,
+                       plan=PlanContext(hot_capacity=hstore.cache_rows,
+                                        total_rows=n,
+                                        dedup_budget=hub_budget))
+    hstate = init_state(model, tx, masked_feature_gather(feat_j, n_id),
+                        layers_to_adjs(layers, bs, sizes),
+                        jax.random.key(4))
+
+    def hub_lookup(ids):
+        rows, counters = hstore._lookup_tiered(
+            hstore.device_part, hhost, ids, hstore.feature_order,
+            False, True)
+        jax.block_until_ready(rows)
+        hub.observe_counters(counters)
+        return rows
+
+    def one_hub_step(state, it):
+        seeds = jnp.asarray(rng.integers(0, n, bs, dtype=np.int32))
+        t0 = _time.perf_counter()
+        state, loss, counters = mstep(state, feat_j, None, indptr_j,
+                                      indices_j, seeds, labels[seeds],
+                                      jax.random.key(it))
+        hub.observe_step(_time.perf_counter() - t0, counters)
+        return state, loss
+
+    # warmup: compile lookup + step (mstep is phase 5's — already
+    # warm), settle caches, arm the hub's own recompile watch
+    hub_lookup(next(iter(dup_batches(1))))
+    hstate, _ = one_hub_step(hstate, 0)
+    hub.flush()
+    hub.watch_compiles(hstore._lookup_tiered, *mstep.jitted_fns)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = hstore._lookup_tiered._cache_size()
+
+    for i, ids in enumerate(dup_batches(50)):
+        hub_lookup(ids)
+        hstate, hloss = one_hub_step(hstate, 200 + i)
+        if i % 10 == 9:
+            hub.replan()
+    jax.block_until_ready(hloss)
+    hub.flush()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = hstore._lookup_tiered._cache_size() - base_cache
+    rec_series = hub.series.get("recompiles")
+    hit_series = hub.series["hot_hit_rate"]
+    print(f"phase 9 live arrays: {base_arrays} -> {arrays}; "
+          f"hub-metered lookup executable-cache growth: {grew}; "
+          f"hot_hit_rate series {len(hit_series)}/{RING} "
+          f"(total {hit_series.total}); advice keys: "
+          f"{sorted(hub.advice)}")
+    assert grew == 0, "telemetry-hub lookup recompiled mid-loop"
+    assert rec_series is not None and float(
+        rec_series.values().max()) == 0.0, \
+        "hub recompile watch saw executable-cache growth"
+    assert not any(a["series"] == "recompiles" for a in hub.anomalies), \
+        "spike detector fired on recompiles in a static-shape loop"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak in the telemetry-hub loop"
+    assert len(hit_series) == RING and hit_series.wrapped, \
+        "series ring did not wrap at capacity (phase premise: steps " \
+        "must exceed the ring)"
+    assert "dedup_budget" in hub.advice and \
+        hub.advice["dedup_budget"]["recommended"] > hub_budget, \
+        "advisor missed the observed dedup-budget overflow"
+    with open(hub_sink_path) as f:
+        kinds = [_json.loads(l)["kind"] for l in f if l.strip()]
+    assert "advice" in kinds, "advice records never reached the sink"
+    hub_sink.close()
+    hstore.close()
+    print("no leak detected (phase 9: telemetry hub + detectors + "
+          "advisor live, wrapped series rings)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,237 @@
+"""qt_top — a live ANSI dashboard over the metrics JSONL sink.
+
+``top`` for a quiver_tpu run: tail the ``MetricsSink`` JSONL the
+training loop / server / bench leaves behind (``QT_METRICS_JSONL``) and
+render, in place, one compact frame per refresh:
+
+- a sparkline per time-series (derived counter ratios out of
+  ``step_stats`` records, bench trajectory values, per-request p99 and
+  queue depth out of ``serving`` records, SLO burn rates);
+- the SLO error-budget line (short/long burn, remaining budget,
+  SHEDDING highlighted);
+- recent ``anomaly`` records (highlighted red — the change-point
+  detectors' verdicts), the latest ``advice`` per knob (yellow — the
+  advisory re-planner's recommendations), and the latest ``regress``
+  verdicts from the bench sentinel.
+
+Reads across the sink's rollover seam (``<path>.1`` before ``<path>``,
+the ``MetricsSink(max_bytes=...)`` convention), so a size-bounded
+week-long watch still renders its full retained window.
+
+Stdlib only — no jax, no numpy, no curses dependency beyond ANSI
+escapes (works in any terminal, over ssh, in tmux). ``--once`` prints
+a single frame and exits (what tests and cron snapshots use).
+
+Usage: python scripts/qt_top.py [--jsonl PATH] [--interval 2.0]
+           [--limit 4096] [--width 48] [--once] [--no-color]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+RED = "\x1b[31m"
+YELLOW = "\x1b[33m"
+GREEN = "\x1b[32m"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+RESET = "\x1b[0m"
+
+
+def read_records(path, limit):
+    """The last ``limit`` records across the rollover seam: ``path.1``
+    (the rolled-over older half) before ``path``; unparseable lines
+    skipped (a live writer's torn tail must not kill the view)."""
+    recs = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    recs.append(rec)
+    return recs[-limit:]
+
+
+def _num(v):
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def build_series(records):
+    """kind-keyed record stream -> {series name: [values]} plus the
+    event lists (anomalies, advice, regress, slo)."""
+    series = {}
+    anomalies, advice, regress = [], {}, {}
+    slo = None
+
+    def put(name, v):
+        if _num(v):
+            series.setdefault(name, []).append(float(v))
+
+    def put_slo(rec):
+        # every slo-bearing record contributes burn-rate POINTS (the
+        # trend is the whole point of the sparkline); the newest
+        # record also becomes the summary line
+        w = rec.get("windows") or {}
+        put("slo_burn_short", (w.get("short") or {}).get("burn_rate"))
+        put("slo_burn_long", (w.get("long") or {}).get("burn_rate"))
+        return rec
+
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "step_stats" or kind == "serving":
+            for k, v in (rec.get("derived") or {}).items():
+                put(k, v)
+            wall = rec.get("wall") or {}
+            put("batch_p50_ms" if kind == "serving" else "step_p50_ms",
+                wall.get("p50_ms"))
+            req = rec.get("request") or {}
+            put("request_p99_ms", req.get("p99_ms"))
+            sv = rec.get("serving") or {}
+            put("queue_depth", sv.get("queue_depth"))
+            put("shed_level", sv.get("shed_level"))
+            put("batch_fill", sv.get("mean_batch_fill"))
+            if "slo" in rec:
+                slo = put_slo(rec["slo"])
+        elif kind == "slo":
+            slo = put_slo(rec)
+        elif kind == "bench":
+            if _num(rec.get("value")):
+                put(f"bench:{rec.get('metric', '?')}", rec["value"])
+            for k in ("feature_gather_rows_per_s", "cold_rows_per_s",
+                      "prefetch_hit_rate"):
+                put(f"bench:{k}", rec.get(k))
+        elif kind == "anomaly":
+            anomalies.append(rec)
+        elif kind == "advice":
+            advice[rec.get("key", "?")] = rec
+        elif kind == "regress":
+            regress[(rec.get("metric", "?"),
+                     rec.get("platform", "?"))] = rec
+    return series, anomalies, advice, regress, slo
+
+
+def sparkline(values, width):
+    v = values[-width:]
+    lo, hi = min(v), max(v)
+    if hi <= lo:
+        return SPARK[0] * len(v)
+    scale = (len(SPARK) - 1) / (hi - lo)
+    return "".join(SPARK[int((x - lo) * scale)] for x in v)
+
+
+def fmt(v):
+    if abs(v) >= 1e5:
+        return f"{v:.3g}"
+    if abs(v) >= 100:
+        return f"{v:.0f}"
+    return f"{v:.3f}"
+
+
+def render(path, limit, width, color=True):
+    c = (lambda code, s: f"{code}{s}{RESET}") if color else \
+        (lambda code, s: s)
+    records = read_records(path, limit)
+    series, anomalies, advice, regress, slo = build_series(records)
+    lines = [c(BOLD, f"qt_top — {path}  "
+                     f"({len(records)} records, "
+                     f"{time.strftime('%H:%M:%S')})")]
+    if not records:
+        lines.append("  (no records yet — is QT_METRICS_JSONL set and "
+                     "the run emitting?)")
+        return "\n".join(lines)
+    name_w = max((len(n) for n in series), default=0)
+    for name in sorted(series):
+        v = series[name]
+        lines.append(f"  {name:<{name_w}}  "
+                     f"{sparkline(v, width):<{width}}  "
+                     f"{fmt(v[-1]):>10}  "
+                     + c(DIM, f"(n={len(v)}, min {fmt(min(v))}, "
+                              f"max {fmt(max(v))})"))
+    if slo is not None:
+        w = slo.get("windows") or {}
+        s = (w.get("short") or {}).get("burn_rate")
+        l = (w.get("long") or {}).get("burn_rate")
+        rem = slo.get("budget_remaining")
+        shedding = bool(slo.get("shedding"))
+        txt = (f"slo: burn {s if s is not None else 'n/a'} (short) / "
+               f"{l if l is not None else 'n/a'} (long), budget left "
+               f"{rem if rem is not None else 'n/a'}")
+        if shedding:
+            txt += "  SHEDDING"
+        lines.append(c(RED if shedding else GREEN, txt))
+    for a in anomalies[-6:]:
+        lines.append(c(RED, f"  ANOMALY [{a.get('detector')}] "
+                           f"{a.get('series')}: "
+                           f"{a.get('baseline')} -> {a.get('value')} "
+                           f"(step {a.get('step')})"))
+    for key in sorted(advice):
+        rec = advice[key]
+        lines.append(c(YELLOW, f"  advice [{key}]: "
+                               f"{rec.get('current')} -> "
+                               f"{rec.get('recommended')}  "
+                               f"{rec.get('reason', '')}"))
+    for (metric, platform) in sorted(regress):
+        rec = regress[(metric, platform)]
+        bad = bool(rec.get("regressed"))
+        ratio = rec.get("ratio")
+        lines.append(c(RED if bad else GREEN,
+                       f"  regress [{metric} @ {platform}]: "
+                       f"latest {rec.get('value')} vs best "
+                       f"{rec.get('best')} "
+                       f"(ratio {ratio if ratio is not None else 'n/a'})"
+                       f"{'  REGRESSED' if bad else ''}"))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--jsonl",
+                    default=os.environ.get("QT_METRICS_JSONL",
+                                           "benchmarks/metrics.jsonl"))
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--limit", type=int, default=4096,
+                    help="render at most the last N records")
+    ap.add_argument("--width", type=int, default=48,
+                    help="sparkline width (points)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen control)")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args(argv)
+    # color keys on the terminal, never on the mode: `--once >> log`
+    # from cron must not fill the log with escape sequences
+    color = not args.no_color and bool(sys.stdout.isatty()
+                                       or os.environ.get("FORCE_COLOR"))
+    if args.once:
+        print(render(args.jsonl, args.limit, args.width, color=color))
+        return 0
+    try:
+        while True:
+            frame = render(args.jsonl, args.limit, args.width,
+                           color=color)
+            # home, draw (clearing each line's stale tail), then clear
+            # only BELOW the new frame — a full pre-clear would blank
+            # the screen before the frame text arrives (per-interval
+            # flicker on slow terminals)
+            sys.stdout.write("\x1b[H"
+                             + frame.replace("\n", "\x1b[K\n")
+                             + "\x1b[K\n\x1b[0J")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

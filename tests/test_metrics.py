@@ -550,3 +550,155 @@ class TestServingTelemetry:
         np.testing.assert_array_equal(jitted, eager)
         assert jitted[qm.HOT_ROWS] == 11
         assert jitted[qm.EXCH_BUCKET_MAX] == 6
+
+
+class TestCrossHostCounterMerge:
+    """``merge_counters=True``: the per-shard counter block folds over
+    the host axis ON DEVICE (psum add slots, pmax max slots) so every
+    host's ``last_counters`` is the global vector — the per-slot
+    semantics must survive the device-side reduction, the rows/losses
+    must stay bit-identical merge on/off, and the merged program must
+    stay free of host-sync equations."""
+
+    def test_lookup_merge_matches_host_fold(self, dist_setup, rng):
+        (n, dim, classes, hosts, indptr, indices, feat, labels, g2h,
+         mesh, info, comm) = dist_setup
+        cap = 8
+        off = qv.DistFeature.from_partition(feat, info, comm,
+                                            exchange_cap=cap,
+                                            collect_metrics=True)
+        on = qv.DistFeature.from_partition(feat, info, comm,
+                                           exchange_cap=cap,
+                                           collect_metrics=True,
+                                           merge_counters=True)
+        per_shard = 96
+        for dup_heavy in (True, False):       # narrow AND fallback
+            if dup_heavy:
+                pool = rng.integers(0, n, 12)
+                ids = pool[rng.integers(0, pool.size,
+                                        hosts * per_shard)]
+            else:
+                ids = rng.integers(0, n, hosts * per_shard)
+            ids = jnp.asarray(ids.astype(np.int32))
+            r_off = off[ids]
+            r_on = on[ids]
+            assert np.asarray(r_off).tobytes() == \
+                np.asarray(r_on).tobytes()
+            assert off.last_counters.shape == (hosts, qm.NUM_COUNTERS)
+            assert on.last_counters.shape == (qm.NUM_COUNTERS,)
+            # device psum/pmax == host add/max fold of the raw block
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(on.last_counters))
+                .astype(np.int64),
+                qm.reduce_counters(off.last_counters))
+
+    def test_metered_dist_losses_bit_identical_merge_on_off(
+            self, dist_setup, rng):
+        (n, dim, classes, hosts, indptr, indices, feat, labels, g2h,
+         mesh, info, comm) = dist_setup
+        from quiver_tpu.models import GraphSAGE
+        dist = qv.DistFeature.from_partition(feat, info, comm)
+        sizes, per_host = [3, 2], 8
+        model = GraphSAGE(hidden_dim=16, out_dim=classes, num_layers=2,
+                          dropout=0.0)
+        tx = optax.adam(1e-2)
+        ip = jnp.asarray(indptr.astype(np.int32))
+        ix = jnp.asarray(indices)
+        n_id, layers = sample_multihop(
+            ip, ix, jnp.arange(per_host, dtype=jnp.int32), sizes,
+            jax.random.key(0))
+        state = init_state(model, tx,
+                           masked_feature_gather(jnp.asarray(feat), n_id),
+                           layers_to_adjs(layers, per_host, sizes),
+                           jax.random.key(1))
+        sharding = NamedSharding(mesh, P("host"))
+        common = (dist._spmd_feat, info.global2host.astype(jnp.int32),
+                  info.global2local, ip, ix)
+        kwargs = dict(rows_per_host=dist._rows_per_host, donate=False,
+                      exchange_cap=6, collect_metrics=True)
+        off = build_dist_train_step(model, tx, sizes, per_host, mesh,
+                                    **kwargs)
+        on = build_dist_train_step(model, tx, sizes, per_host, mesh,
+                                   merge_counters=True, **kwargs)
+        seeds = jax.device_put(jnp.asarray(
+            rng.choice(n, hosts * per_host,
+                       replace=False).astype(np.int32)), sharding)
+        y = jax.device_put(jnp.asarray(labels)[seeds], sharding)
+        key = jax.random.key(77)
+        _, l_off, c_off = off(state, *common, seeds, y, key)
+        _, l_on, c_on = on(state, *common, seeds, y, key)
+        assert np.asarray(l_off).tobytes() == np.asarray(l_on).tobytes()
+        assert c_off.shape == (hosts, qm.NUM_COUNTERS)
+        assert c_on.shape == (qm.NUM_COUNTERS,)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(c_on)).astype(np.int64),
+            qm.reduce_counters(c_off))
+
+    def test_merged_lookup_has_no_host_sync(self, dist_setup, rng):
+        (n, dim, classes, hosts, indptr, indices, feat, labels, g2h,
+         mesh, info, comm) = dist_setup
+        from quiver_tpu.comm import build_dist_lookup_fn
+        rows = 40
+        fn = build_dist_lookup_fn(mesh, "host", rows_per_host=rows,
+                                  batch_per_host=16, exchange_cap=4,
+                                  collect_metrics=True,
+                                  merge_counters=True)
+        ids = jnp.asarray(rng.integers(0, n, hosts * 16, np.int32))
+        spmd = jnp.asarray(
+            rng.standard_normal((hosts * rows, dim)).astype(np.float32))
+        args = (ids, info.global2host.astype(jnp.int32),
+                info.global2local, spmd)
+        assert host_sync_eqns(fn, args) == []
+
+    def test_e2e_merge_shape_and_no_host_sync(self, rng):
+        # abstract pins only (trace, no compile): the DP builder's
+        # merged counters leave as ONE global [N] vector and the traced
+        # program stays sync-free
+        from quiver_tpu.models import GraphSAGE
+        from quiver_tpu.parallel import build_e2e_train_step
+        n, dim, classes = 200, 8, 4
+        deg = rng.integers(1, 6, n)
+        indptr = np.zeros(n + 1, np.int64)
+        np.cumsum(deg, out=indptr[1:])
+        indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+        feat = rng.standard_normal((n, dim)).astype(np.float32)
+        labels = rng.integers(0, classes, n).astype(np.int32)
+        sizes, per_dev = [3, 2], 4
+        ndev = len(jax.devices())
+        mesh = Mesh(np.array(jax.devices()), axis_names=("data",))
+        model = GraphSAGE(hidden_dim=8, out_dim=classes, num_layers=2,
+                          dropout=0.0)
+        tx = optax.adam(1e-2)
+        ip = jnp.asarray(indptr.astype(np.int32))
+        ix = jnp.asarray(indices)
+        n_id, layers = sample_multihop(
+            ip, ix, jnp.arange(per_dev, dtype=jnp.int32), sizes,
+            jax.random.key(0))
+        state = init_state(model, tx,
+                           masked_feature_gather(jnp.asarray(feat), n_id),
+                           layers_to_adjs(layers, per_dev, sizes),
+                           jax.random.key(1))
+        step = build_e2e_train_step(model, tx, sizes, per_dev, mesh,
+                                    donate=False, collect_metrics=True,
+                                    merge_counters=True)
+        seeds = jnp.asarray(
+            rng.choice(n, ndev * per_dev, replace=False).astype(np.int32))
+        args = (state, jnp.asarray(feat), None, ip, ix, seeds,
+                jnp.asarray(labels)[seeds], jax.random.key(2))
+        shapes = jax.eval_shape(step, *args)
+        assert shapes[2].shape == (qm.NUM_COUNTERS,)
+        assert host_sync_eqns(step, args) == []
+        with pytest.raises(ValueError, match="merge_counters"):
+            build_e2e_train_step(model, tx, sizes, per_dev, mesh,
+                                 merge_counters=True)
+
+    def test_merge_requires_collect(self, dist_setup):
+        (n, dim, classes, hosts, indptr, indices, feat, labels, g2h,
+         mesh, info, comm) = dist_setup
+        from quiver_tpu.comm import build_dist_lookup_fn
+        with pytest.raises(ValueError, match="merge_counters"):
+            build_dist_lookup_fn(mesh, "host", 10, 8,
+                                 merge_counters=True)
+        with pytest.raises(ValueError, match="merge_counters"):
+            qv.DistFeature.from_partition(feat, info, comm,
+                                          merge_counters=True)
