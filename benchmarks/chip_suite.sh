@@ -7,8 +7,8 @@
 # evidence pipeline commits it with -f).
 #
 # Usage: sh benchmarks/chip_suite.sh [section ...]
-#   sections: bench dispatch sampler gather tiered offload e2e exchange
-#             mixed hetero micro ablate regress
+#   sections: verify bench dispatch sampler gather tiered offload e2e
+#             exchange mixed hetero micro ablate regress
 #   default       = every section
 #   quick         = bench only (the metric of record; also warms the
 #                   compile cache for a later full sweep)
@@ -24,7 +24,7 @@ export QT_METRICS_JSONL
 SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-bench dispatch sampler gather tiered offload e2e exchange mixed hetero micro ablate regress}"
+SECTIONS="${*:-verify bench dispatch sampler gather tiered offload e2e exchange mixed hetero micro ablate regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -37,6 +37,14 @@ echo "sections: $SECTIONS" | tee -a "$LOG"
 if ! canary; then
     echo "canary: device unusable; aborting suite (re-arm via benchmarks/arm_watch.sh)" | tee -a "$LOG"
     exit 1
+fi
+
+# static invariant verifier FIRST: host AST rules + jaxpr rules over
+# the FULL entry-point registry (CPU, tracing only — never claims the
+# chip); ERROR findings land as `lint` JSONL records beside the bench
+# history, so qt_top shows them red in the same view
+if want verify; then
+    step env JAX_PLATFORMS=cpu python -u scripts/qt_verify.py --jsonl "$QT_METRICS_JSONL"
 fi
 
 # metric of record: the full default sweep (pair/sort, overlap/sort,

@@ -54,8 +54,8 @@ from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
                       ServeEngine, build_serve_step)
 from .telemetry import FlightRecorder, PlanContext, TelemetryHub
-from . import (comm, profiling, checkpoint, datasets, debug, metrics,
-               serving, telemetry, tracing)
+from . import (analysis, comm, profiling, checkpoint, datasets, debug,
+               metrics, serving, telemetry, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo

@@ -1,0 +1,25 @@
+"""Static analysis subsystem — ``scripts/qt_verify.py``'s engine.
+
+Two halves:
+
+- :mod:`~quiver_tpu.analysis.jaxpr_lint` — the jaxpr verifier: walks
+  TRACED programs for host syncs, dishonored donation, divergent
+  cond collectives, traffic-budget violations, and an executable
+  census per registered entry point (imports jax).
+- :mod:`~quiver_tpu.analysis.host_lint` — the AST verifier for
+  host-side bug classes (lock-held sink emission, unfinalized thread
+  resources, blocking syncs in ``@hot_path`` functions); stdlib only.
+
+:mod:`~quiver_tpu.analysis.registry` declares the real entry points
+(train/dist/e2e/serve builders, ``lookup_tiered``,
+``dist_lookup_local``) with their budgets and census lattices. See
+docs/analysis.md for the rule table and the ``lint`` JSONL schema.
+"""
+
+from . import host_lint  # noqa: F401  (stdlib-only half)
+from .findings import ERROR, INFO, WARN, Finding, has_errors, \
+    sort_findings  # noqa: F401
+from .jaxpr_lint import (CensusSpec, EntrySpec, RULES,  # noqa: F401
+                         collective_payloads, divergent_cond_collectives,
+                         gather_reads, host_sync_eqns, run_rules,
+                         tier_read_bytes)

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
 from .ops import quant
 from .ops.dedup import I32_MAX, unique_within_budget
+from .profiling import hot_path
 
 
 def get_comm_id() -> bytes:
@@ -145,6 +146,7 @@ def default_exchange_cap(batch: int, hosts: int, slack: float = 1.25) -> int:
     return min(batch, cap_for_expected_load(uniq / hosts, slack))
 
 
+@hot_path
 def dist_lookup_local(ids: jax.Array, g2h: jax.Array, loc: jax.Array,
                       feat, axis: str, h_count: int,
                       rows_per_host: int, dtype=None, rep=None,

@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .ops import quant
+from .profiling import hot_path
 from .utils import CSRTopo, parse_size, reindex_feature
 
 
@@ -412,6 +413,7 @@ class Feature:
                         if dedup and not isinstance(self.dedup_cold, bool)
                         else None)
 
+        @hot_path
         def lookup_tiered_body(dev_part, host_part, ids, order,
                                masked=False, collector=None):
             # one dispatch for the WHOLE tiered lookup: hot rows from

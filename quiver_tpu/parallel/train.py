@@ -25,6 +25,7 @@ from .._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.sample_multihop import sample_multihop
+from ..profiling import hot_path
 from ..pyg.sage_sampler import Adj, layer_shapes
 
 
@@ -51,6 +52,7 @@ def layers_to_adjs(layers, batch_size: int, sizes: Sequence[int]):
     return adjs[::-1]
 
 
+@hot_path
 def masked_feature_gather(feat, n_id: jax.Array,
                           feature_order=None,
                           collector=None) -> jax.Array:
@@ -71,6 +73,7 @@ def masked_feature_gather(feat, n_id: jax.Array,
     return x * (n_id >= 0).astype(x.dtype)[:, None]
 
 
+@hot_path
 def dedup_feature_gather(feat, n_id: jax.Array,
                          feature_order=None,
                          budget: int | None = None,
@@ -108,6 +111,7 @@ def dedup_feature_gather(feat, n_id: jax.Array,
                         narrow, None)
 
 
+@hot_path
 def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
                 indptr, indices, seeds, labels, key, method="exact",
                 indices_rows=None, indices_stride=None, gather=None,

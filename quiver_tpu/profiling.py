@@ -35,6 +35,18 @@ def trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
+def hot_path(fn):
+    """Marker for sync-free hot-path functions — the contract
+    ``analysis.host_lint`` verifies statically: a function carrying
+    this decorator must never block on the device
+    (``jax.device_get`` / ``.block_until_ready()`` / ``np.asarray`` on
+    a jax array). The marker adds NO wrapper (jit/donation semantics
+    untouched); it only stamps ``__qt_hot_path__`` so tools can find
+    the marked set."""
+    fn.__qt_hot_path__ = True
+    return fn
+
+
 def annotate(name: str):
     """Decorator form of ``scope`` for hot functions.
 

@@ -85,6 +85,7 @@ import numpy as np
 from . import tracing
 from .parallel.train import (dedup_feature_gather, layers_to_adjs,
                              masked_feature_gather)
+from .profiling import hot_path
 
 
 class OverloadError(RuntimeError):
@@ -134,6 +135,7 @@ def build_serve_step(model, sizes: Sequence[int], batch_cap: int,
                   dedup_feature_gather(feat, n_id, forder, budget,
                                        collector=collector))
 
+    @hot_path
     def forward(params, key, feat, forder, indptr, indices, seeds,
                 collector=None):
         key, sub = jax.random.split(key)
@@ -147,6 +149,7 @@ def build_serve_step(model, sizes: Sequence[int], batch_cap: int,
             logits = model.apply(params, x, adjs, train=False)
         return key, logits[:batch_cap]
 
+    @hot_path
     def raw(params, key, feat, forder, indptr, indices, seeds):
         if not collect_metrics:
             return forward(params, key, feat, forder, indptr, indices,

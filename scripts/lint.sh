@@ -11,6 +11,8 @@
 #     have a matching backticked row in docs/observability.md (the
 #     `serving` kind was added by hand in PR 6; this makes the doc
 #     contract mechanical)
+#   - qt_verify --quick: the static invariant verifier (host AST rules
+#     + jaxpr rules over the mini entry-point matrix)
 #   - the native C++ engine passes g++ -fsyntax-only
 set -e
 cd "$(dirname "$0")/.."
@@ -20,7 +22,8 @@ python - <<'EOF'
 import ast, pathlib, py_compile, sys, tabnanny
 
 fail = 0
-srcs = [p for d in ("quiver_tpu", "tests", "benchmarks", "examples")
+srcs = [p for d in ("quiver_tpu", "tests", "benchmarks", "examples",
+                    "scripts")
         for p in pathlib.Path(d).rglob("*.py")]
 srcs += [pathlib.Path("bench.py"), pathlib.Path("__graft_entry__.py")]
 for p in srcs:
@@ -119,9 +122,8 @@ def kind_literals(tree):
                         and isinstance(d.value, str):
                     yield d.value
 
-kind_srcs = srcs + [p for p in pathlib.Path("scripts").glob("*.py")]
 kinds = {}
-for p in kind_srcs:
+for p in srcs:
     for k in kind_literals(ast.parse(p.read_text())):
         kinds.setdefault(k, p)
 for name in slot_names:
@@ -136,6 +138,11 @@ for kind, src in sorted(kinds.items()):
         fail = 1
 sys.exit(fail)
 EOF
+
+echo "== qt_verify --quick (static invariant verifier) =="
+# host AST rules + the jaxpr rules over the mini entry-point matrix
+# (CPU, tracing only — no compiles); any ERROR finding fails the lint
+JAX_PLATFORMS=cpu python scripts/qt_verify.py --quick
 
 echo "== native C++ syntax =="
 for src in quiver_tpu/native/*.cpp; do

@@ -11,8 +11,10 @@ render, in place, one compact frame per refresh:
   SHEDDING highlighted);
 - recent ``anomaly`` records (highlighted red — the change-point
   detectors' verdicts), the latest ``advice`` per knob (yellow — the
-  advisory re-planner's recommendations), and the latest ``regress``
-  verdicts from the bench sentinel.
+  advisory re-planner's recommendations), the latest ``regress``
+  verdicts from the bench sentinel, and ``lint`` findings from
+  ``scripts/qt_verify.py`` (ERROR red, WARN yellow — the static
+  invariant verifier's verdicts).
 
 Reads across the sink's rollover seam (``<path>.1`` before ``<path>``,
 the ``MetricsSink(max_bytes=...)`` convention), so a size-bounded
@@ -72,7 +74,7 @@ def build_series(records):
     """kind-keyed record stream -> {series name: [values]} plus the
     event lists (anomalies, advice, regress, slo)."""
     series = {}
-    anomalies, advice, regress = [], {}, {}
+    anomalies, advice, regress, lint = [], {}, {}, {}
     slo = None
 
     def put(name, v):
@@ -119,7 +121,11 @@ def build_series(records):
         elif kind == "regress":
             regress[(rec.get("metric", "?"),
                      rec.get("platform", "?"))] = rec
-    return series, anomalies, advice, regress, slo
+        elif kind == "lint" and rec.get("level") in ("ERROR", "WARN"):
+            # latest per (rule, entry) — repeated suite runs re-emit
+            # the same finding and must not flood the display window
+            lint[(rec.get("rule", "?"), rec.get("entry", "?"))] = rec
+    return series, anomalies, advice, regress, lint, slo
 
 
 def sparkline(values, width):
@@ -143,7 +149,8 @@ def render(path, limit, width, color=True):
     c = (lambda code, s: f"{code}{s}{RESET}") if color else \
         (lambda code, s: s)
     records = read_records(path, limit)
-    series, anomalies, advice, regress, slo = build_series(records)
+    series, anomalies, advice, regress, lint, slo = \
+        build_series(records)
     lines = [c(BOLD, f"qt_top — {path}  "
                      f"({len(records)} records, "
                      f"{time.strftime('%H:%M:%S')})")]
@@ -182,6 +189,13 @@ def render(path, limit, width, color=True):
                                f"{rec.get('current')} -> "
                                f"{rec.get('recommended')}  "
                                f"{rec.get('reason', '')}"))
+    for key in sorted(lint)[:8]:
+        rec = lint[key]
+        bad = rec.get("level") == "ERROR"
+        lines.append(c(RED if bad else YELLOW,
+                       f"  lint {rec.get('level')} "
+                       f"[{rec.get('rule')}] {rec.get('entry')}: "
+                       f"{rec.get('msg')}"))
     for (metric, platform) in sorted(regress):
         rec = regress[(metric, platform)]
         bad = bool(rec.get("regressed"))

@@ -1,139 +1,19 @@
-"""Shared jaxpr byte-traffic assertions.
+"""Shared jaxpr byte-traffic assertions — re-export shim.
 
-The tiered lookup's traffic bounds (host-tier reads scale with the
-budget, not the batch; int8 tiers move storage-width bytes, not fp32;
-the exchange ships narrow payloads through its collectives) are pinned
-on the TRACED program, not on timings: walk the jaxpr for gather
-equations whose operand is a given tier's storage — or for collective
-equations' payloads — record sizes and the ``lax.cond`` nesting depth
-(depth 0 = the always-taken narrow path; deeper = fallback branches),
-and sum bytes. Shared by tests/test_feature.py's budget pins and
-tests/test_quant.py's int8-vs-fp32 byte-ratio pins so the walker can't
-drift between them.
+The walkers moved into ``quiver_tpu.analysis.jaxpr_lint`` (the static
+invariant verifier absorbed them as its rule engine). This shim keeps
+every existing traffic pin importing from ``_traffic`` running against
+THE one implementation, so the pins and ``scripts/qt_verify.py`` can
+never drift apart. New code should import from
+``quiver_tpu.analysis.jaxpr_lint`` directly.
 """
 
 from __future__ import annotations
 
-import numpy as np
+from quiver_tpu.analysis import jaxpr_lint as _jaxpr_lint
 
-import jax
-
-
-def _sub_jaxprs(eqn):
-    """Every inner jaxpr a primitive's params carry (pjit/closed calls,
-    shard_map's open jaxpr, scan bodies) EXCEPT cond branches — the
-    walkers treat those specially to track fallback depth."""
-    for name, sub in eqn.params.items():
-        if eqn.primitive.name == "cond" and name == "branches":
-            continue
-        vals = sub if isinstance(sub, (tuple, list)) else (sub,)
-        for v in vals:
-            if hasattr(v, "jaxpr"):
-                yield v.jaxpr
-            elif hasattr(v, "eqns"):
-                yield v
-
-
-def gather_reads(jaxpr, src_shape, dtype=None):
-    """Gather equations reading an operand of ``src_shape`` (and
-    optionally ``dtype``) anywhere in ``jaxpr`` (a ClosedJaxpr or inner
-    jaxpr). Returns ``[(out_rows, cond_depth)]`` — ``cond_depth`` 0 for
-    reads on the unconditional path, +1 per enclosing ``lax.cond``
-    branch (fallback paths)."""
-    jxp = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
-
-    def walk(j, depth):
-        out = []
-        for eqn in j.eqns:
-            if eqn.primitive.name == "cond":
-                for br in eqn.params["branches"]:
-                    out += walk(br.jaxpr, depth + 1)
-            elif eqn.primitive.name == "gather":
-                aval = eqn.invars[0].aval
-                if tuple(aval.shape) == tuple(src_shape) and \
-                        (dtype is None or aval.dtype == dtype):
-                    out.append((eqn.outvars[0].aval.shape[0], depth))
-            for sub in _sub_jaxprs(eqn):
-                out += walk(sub, depth)
-        return out
-
-    return walk(jxp, 0)
-
-
-def tier_read_bytes(fn, args, tier, max_depth=0):
-    """Total bytes ``fn(*args)``'s traced program gathers from
-    ``tier``'s storage at cond depth <= ``max_depth`` (default: only
-    the always-taken narrow path). ``tier`` is a plain array or a
-    quantized-tier pytree — sidecar reads count toward the total, so
-    the byte comparison against an fp32 tier is honest."""
-    jaxpr = jax.make_jaxpr(fn)(*args)
-    # distinct (shape, dtype) specs, ONCE each: a quantized tier's
-    # scale and zero share a spec, and counting per leaf would tally
-    # each matching gather equation twice
-    specs = {(tuple(leaf.shape), jax.numpy.dtype(leaf.dtype))
-             for leaf in jax.tree_util.tree_leaves(tier)}
-    total = 0
-    for shape, dt in specs:
-        width = int(np.prod(shape[1:])) * dt.itemsize
-        for rows, depth in gather_reads(jaxpr, shape, dt):
-            if depth <= max_depth:
-                total += rows * width
-    return total
-
-
-def host_sync_eqns(fn, args,
-                   prims=("io_callback", "pure_callback",
-                          "debug_callback", "python_callback",
-                          "infeed", "outfeed")):
-    """Every host-round-trip equation in the traced program — the
-    structural pin that a jitted path performs ZERO per-step host
-    syncs (the metrics counters must ride out as a plain device
-    output, never via a callback). Returns ``[primitive_name]``;
-    assert it is empty."""
-    jaxpr = jax.make_jaxpr(fn)(*args)
-
-    def walk(j):
-        out = []
-        for eqn in j.eqns:
-            if eqn.primitive.name in prims:
-                out.append(eqn.primitive.name)
-            if eqn.primitive.name == "cond":
-                for br in eqn.params["branches"]:
-                    out += walk(br.jaxpr)
-            for sub in _sub_jaxprs(eqn):
-                out += walk(sub)
-        return out
-
-    return walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
-
-
-def collective_payloads(fn, args, prims=("all_to_all",),
-                        with_depth=False):
-    """Every collective equation's payload in the traced program —
-    the exchange's wire traffic. Returns ``[(shape, dtype, bytes)]``
-    (requests AND responses both appear; callers filter by shape/dtype
-    when they want one direction). ``with_depth=True`` appends the
-    ``lax.cond`` nesting depth as a fourth element (0 = the
-    unconditional path; the compact exchange keeps BOTH its narrow
-    collectives and the dense fallback inside one cond, so callers
-    separate them by payload shape, and use depth to assert nothing
-    dense-shaped leaked onto the unconditional path)."""
-    jaxpr = jax.make_jaxpr(fn)(*args)
-
-    def walk(j, depth):
-        out = []
-        for eqn in j.eqns:
-            if eqn.primitive.name in prims:
-                aval = eqn.invars[0].aval
-                rec = (tuple(aval.shape),
-                       jax.numpy.dtype(aval.dtype),
-                       int(np.prod(aval.shape)) * aval.dtype.itemsize)
-                out.append(rec + (depth,) if with_depth else rec)
-            if eqn.primitive.name == "cond":
-                for br in eqn.params["branches"]:
-                    out += walk(br.jaxpr, depth + 1)
-            for sub in _sub_jaxprs(eqn):
-                out += walk(sub, depth)
-        return out
-
-    return walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr, 0)
+_sub_jaxprs = _jaxpr_lint._sub_jaxprs
+gather_reads = _jaxpr_lint.gather_reads
+tier_read_bytes = _jaxpr_lint.tier_read_bytes
+host_sync_eqns = _jaxpr_lint.host_sync_eqns
+collective_payloads = _jaxpr_lint.collective_payloads
