@@ -534,7 +534,8 @@ def main():
             save_disk_tier(featc, _np.arange(c_rows, dtype=_np.int64),
                            tmp, dtype_policy="int8", overwrite=True)
             store, _meta = load_disk_tier_store(
-                tmp, hot_rows=cache_rows, prefetch_rows=2 * c_batch)
+                tmp, hot_rows=cache_rows, prefetch_rows=2 * c_batch,
+                workers=2)      # the parallel-IO staging path (io.py)
             pf = store._cold_prefetch
             # frontier-shaped batches, half the slots on the disk tier
             ids_c = []
@@ -575,12 +576,13 @@ def main():
             # batch, so the per-batch figure is the timed delta over
             # the batches that PUBLISHED during the loop
             return (cold_slots / dt, hit_rate,
-                    staged / max(n_batches_c - 1, 1))
+                    staged / max(n_batches_c - 1, 1), staged / dt)
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
 
     (cold_rows_per_s, prefetch_hit_rate,
-     prefetch_staged_rows_per_batch) = measure_cold_tier()
+     prefetch_staged_rows_per_batch,
+     cold_staged_rows_per_s) = measure_cold_tier()
     out = {
         "metric": METRIC,
         "value": round(seps, 1),
@@ -630,6 +632,11 @@ def main():
         "prefetch_hit_rate": round(prefetch_hit_rate, 4),
         "prefetch_staged_rows_per_batch":
             round(prefetch_staged_rows_per_batch, 1),
+        # staging THROUGHPUT through the parallel-IO read path
+        # (extents at depth, quiver_tpu/io.py) — its own
+        # bench_regress trajectory group from this round on, so a
+        # QD/coalescing regression fails the sweep loudly
+        "cold_staged_rows_per_s": round(cold_staged_rows_per_s, 1),
     }
     # every measured rotation config, for the record (always present so
     # log consumers never hit a missing key)

@@ -37,7 +37,19 @@ Modes:
                (--cold-fracs) — end-to-end steps/s (gather + a jitted
                compute the staging overlaps), cold rows/s, prefetch
                hit rate; gathered rows and compute sums pinned
-               bit-identical between arms.
+               bit-identical between arms. The ON arm stages through
+               the parallel-IO path (--io-workers staging workers,
+               coalesced extents at --io-qd in-flight preadv reads;
+               quiver_tpu/io.py) and the JSON carries a dedicated
+               staged-rows/s pin: the same publication stream through
+               the QD1 per-row mmap path vs the deep-queue path.
+               Under --storage-latency-us both arms charge a
+               deterministic queue-depth device model (one service
+               time per request, at most --storage-qd overlapped) so
+               a hypervisor page cache cannot hide the win; eviction
+               failures are counted per arm in the JSON so a run
+               where eviction silently stopped working is
+               distinguishable from a regression.
 
 Usage: python benchmarks/bench_feature.py [--rows N] [--dim D]
        [--batch B] [--iters K] [--pallas] [--bf16]
@@ -255,26 +267,30 @@ def run_ab_quant(args, jax, jnp):
 
 
 class ModeledLatencyMmap:
-    """Bench-only storage model: wraps the artifact's memmap and
-    charges a deterministic per-UNIQUE-row latency on every row read —
-    a QD1 NVMe random-read model (``time.sleep`` releases the GIL, so
-    what the prefetcher can overlap is exactly what real IO-wait would
-    give it). This box's page-cache eviction is at the mercy of the
-    hypervisor's own cache (reads swing 1-60 us/row between runs), so
-    the A/B's reproducible arm models the latency instead; pass
-    --storage-latency-us 0 (default) for the real-eviction regime.
-    Everything else (sidecars, decode, ring, scatter) stays the real
-    code path — both the sync read and the staging worker read through
-    this wrapper."""
+    """Bench-only storage shim: wraps the artifact's memmap and
+    charges every UNIQUE row fancy-indexed through it as one request
+    against a shared ``io.StorageModel`` — issued serially from the
+    calling thread, which IS queue depth 1 no matter how deep the
+    modeled device's queue runs (a serial issuer can't overlap with
+    itself). That is exactly the old per-row-page-fault staging
+    regime; the parallel staging path instead reads through
+    ``io.ExtentReader``, charging the SAME model one request per
+    COALESCED extent from each of its reader-pool threads — up to the
+    model's ``qd`` overlapped. One price per request, two issue
+    disciplines: the A/B measures the discipline, which the box's
+    hypervisor page cache (reads swing 1-60 us/row between runs)
+    cannot fake. Pass --storage-latency-us 0 (default) for the
+    real-eviction regime. Everything else (sidecars, decode, ring,
+    scatter) stays the real code path."""
 
-    def __init__(self, mm, latency_us: float):
+    def __init__(self, mm, model):
         self._mm = mm
-        self._latency_s = latency_us * 1e-6
+        self._model = model
 
     def __getitem__(self, ids):
         ids_arr = np.asarray(ids)
         if ids_arr.ndim:
-            time.sleep(np.unique(ids_arr).size * self._latency_s)
+            self._model.request(n=int(np.unique(ids_arr).size))
         return self._mm[ids]
 
     def __getattr__(self, name):
@@ -345,13 +361,23 @@ def run_ab_prefetch(args, jax, jnp):
     probe = jnp.zeros((batch, dim), jnp.float32)
     assert host_sync_eqns(compute, (probe, w)) == []
 
+    from quiver_tpu import io as qio
     from quiver_tpu.partition import load_disk_tier_store
     from quiver_tpu.prefetch import evict_file_cache
 
-    def evict(store):
-        if not args.keep_page_cache:
-            evict_file_cache(store.mmap_array.filename,
-                             mapped=store.mmap_array)
+    # per-arm eviction accounting [calls, failures]: a run where
+    # eviction silently stopped working (platform lost posix_fadvise,
+    # file moved, ...) measures page-cache memcpy and would otherwise
+    # be indistinguishable from a real regression in the JSON
+    evict_stats = {"off": [0, 0], "on": [0, 0]}
+
+    def evict(store, mode):
+        if args.keep_page_cache:
+            return
+        ok = evict_file_cache(store.mmap_array.filename,
+                              mapped=store.mmap_array)
+        evict_stats[mode][0] += 1
+        evict_stats[mode][1] += 0 if ok else 1
 
     # ONE artifact write per arm (separate files so the page-cache
     # eviction regimes stay isolated); the per-fraction stores below
@@ -361,6 +387,8 @@ def run_ab_prefetch(args, jax, jnp):
         for mode in ("off", "on")}
     out = {}
     for frac in cold_fracs:
+        for v in evict_stats.values():       # per-fraction accounting
+            v[0] = v[1] = 0
         n_cold = int(batch * frac)
         ids_np = []
         for _ in range(iters):
@@ -374,16 +402,25 @@ def run_ab_prefetch(args, jax, jnp):
             ids_np.append(ids.astype(np.int64))
         ids_dev = [jnp.asarray(a) for a in ids_np]
 
+        # prefetch attaches AFTER the model wrap so the ON arm's
+        # ExtentReader and sync fallbacks both run under the model
         stores = {
-            mode: load_disk_tier_store(
-                tmp_dirs[mode], hot_rows=cache_rows,
-                prefetch_rows=(args.prefetch_rows or 4 * batch)
-                if mode == "on" else None)[0]
+            mode: load_disk_tier_store(tmp_dirs[mode],
+                                       hot_rows=cache_rows)[0]
             for mode in ("off", "on")}
+        models = {}
         if args.storage_latency_us:
-            for store in stores.values():
+            for mode, store in stores.items():
+                models[mode] = qio.StorageModel(args.storage_latency_us,
+                                                qd=args.storage_qd)
                 store.mmap_array = ModeledLatencyMmap(
-                    store.mmap_array, args.storage_latency_us)
+                    store.mmap_array, models[mode])
+        ring_rows = args.prefetch_rows or 4 * batch
+        pf_kwargs = dict(workers=args.io_workers, io_qd=args.io_qd,
+                         io_engine=args.io_engine)
+        stores["on"].enable_cold_prefetch(ring_rows,
+                                          io_model=models.get("on"),
+                                          **pf_kwargs)
 
         def run_round(mode, lo, hi):
             """One timed round of steps [lo, hi) through an arm's
@@ -394,7 +431,7 @@ def run_ab_prefetch(args, jax, jnp):
             batch_sums = []
             t0 = time.perf_counter()
             if mode == "on":
-                evict(store)
+                evict(store, mode)
                 f = store.stage_frontier(ids_np[lo])
                 if f is not None:
                     f.result()
@@ -405,10 +442,10 @@ def run_ab_prefetch(args, jax, jnp):
                     y = compute(x, w)    # ...which the disk read overlaps
                     jax.block_until_ready(y)
                     batch_sums.append(y)
-                    evict(store)         # bigger-than-RAM: first-touch
+                    evict(store, mode)   # bigger-than-RAM: first-touch
             else:
                 for i in range(lo, hi):
-                    evict(store)
+                    evict(store, mode)
                     x = store[ids_dev[i]]
                     y = compute(x, w)
                     jax.block_until_ready(y)
@@ -438,6 +475,7 @@ def run_ab_prefetch(args, jax, jnp):
                 sums[mode] += [float(y) for y in batch_sums]
             steps_timed += hi - lo
         arms = {}
+        io_facts = None
         for mode, store in stores.items():
             pf = store._cold_prefetch
             arms[mode] = {
@@ -446,6 +484,15 @@ def run_ab_prefetch(args, jax, jnp):
                 "prefetch_hit_rate": (pf.stats()["hit_rate"]
                                       if pf is not None else None),
             }
+            if pf is not None:
+                s = pf.stats()
+                io_facts = {"engine": s["io"]["engine"],
+                            "extents": s["io"]["extents"],
+                            "coalescing_factor":
+                                s["io"]["coalescing_factor"],
+                            "depth_peak": s["io"]["depth_peak"],
+                            "read_mb": s["io"]["bytes_read"] / 1e6,
+                            "truncated_rows": s["truncated_rows"]}
         # bit-identity, UNTIMED pass one batch at a time (bounded
         # memory at any scale; gather correctness is ring-state-
         # independent, so verifying after the race-y timed loops is
@@ -455,6 +502,32 @@ def run_ab_prefetch(args, jax, jnp):
                            np.asarray(stores["on"][ids]))
             for ids in ids_dev)
         sums_identical = sums["off"] == sums["on"]
+
+        # the staged-rows/s pin: the SAME publication stream staged
+        # through (a) the QD1 per-row mmap path (workers=1,
+        # io_engine="mmap" — the pre-parallel-IO staging worker) and
+        # (b) the deep-queue parallel path (coalesced extents, reader
+        # pool, N staging workers). Fresh ring each so both arms stage
+        # the same demand; under the model both pay the same price per
+        # request — the ratio is pure issue discipline (coalescing x
+        # overlap). Untimed region for the step A/B above; runs after
+        # the bit-identity pass so the arms' lookup behavior stayed
+        # pure while it mattered.
+        def staging_rate(store, model, **kwargs):
+            pf = store.enable_cold_prefetch(ring_rows, io_model=model,
+                                            **kwargs)
+            t0 = time.perf_counter()
+            for a in ids_np:
+                pf.publish(a, block=True).result()
+            dt = time.perf_counter() - t0
+            return pf.stats()["staged_rows"] / dt
+
+        qd1_rate = staging_rate(stores["off"], None, workers=1,
+                                io_engine="mmap")
+        qdn_rate = staging_rate(stores["on"], models.get("on"),
+                                **pf_kwargs)
+        qd_speedup = qdn_rate / max(qd1_rate, 1e-9)
+
         for store in stores.values():
             store.close()
         speedup = (arms["on"]["steps_per_s"]
@@ -463,8 +536,15 @@ def run_ab_prefetch(args, jax, jnp):
             **{f"{k}_{m}": v for m, arm in arms.items()
                for k, v in arm.items() if v is not None},
             "speedup": speedup,
+            "staged_rows_per_s_qd1": qd1_rate,
+            "staged_rows_per_s_qdn": qdn_rate,
+            "staging_qd_speedup": qd_speedup,
             "rows_bit_identical": rows_identical,
             "sums_bit_identical": sums_identical,
+            "evict": {f"{k}_{m}": v for m, (c, f_) in
+                      evict_stats.items()
+                      for k, v in (("calls", c), ("failures", f_))},
+            **({"io": io_facts} if io_facts else {}),
         }
         print(f"[ab-prefetch cold={frac:g}] "
               f"off {arms['off']['steps_per_s']:.2f} steps/s "
@@ -474,14 +554,30 @@ def run_ab_prefetch(args, jax, jnp):
               f" hit {arms['on']['prefetch_hit_rate']:.1%}) -> "
               f"{speedup:.2f}x, rows identical: {rows_identical}, "
               f"sums identical: {sums_identical}")
+        print(f"[ab-prefetch cold={frac:g}] staging: QD1 mmap "
+              f"{qd1_rate / 1e3:.1f} Krows/s | parallel "
+              f"({pf_kwargs['workers']} workers, io_qd="
+              f"{pf_kwargs['io_qd']}) {qdn_rate / 1e3:.1f} Krows/s -> "
+              f"{qd_speedup:.2f}x"
+              + (f" [{io_facts['engine']}, "
+                 f"{io_facts['coalescing_factor']:.1f} rows/extent, "
+                 f"depth peak {io_facts['depth_peak']}]"
+                 if io_facts and io_facts["coalescing_factor"] else ""))
     for d in tmp_dirs.values():
         shutil.rmtree(d, ignore_errors=True)
+    rnd = lambda v: (round(v, 4) if isinstance(v, float) else
+                     {kk: (round(vv, 4) if isinstance(vv, float)
+                           else vv) for kk, vv in v.items()}
+                     if isinstance(v, dict) else v)
     print(json.dumps({"bench": "ab_prefetch", "rows": rows, "dim": dim,
                       "batch": batch, "iters": iters, "dup": dup,
                       "compute_iters": args.compute_iters,
-                      "results": {k: {kk: (round(vv, 4)
-                                           if isinstance(vv, float)
-                                           else vv)
+                      "storage_model": {
+                          "latency_us": args.storage_latency_us,
+                          "qd": args.storage_qd,
+                          "io_workers": args.io_workers,
+                          "io_qd": args.io_qd},
+                      "results": {k: {kk: rnd(vv)
                                       for kk, vv in v.items()}
                                   for k, v in out.items()}}))
 
@@ -531,11 +627,30 @@ def main():
                         "first-touch reads")
     p.add_argument("--storage-latency-us", type=float, default=0.0,
                    help="with --ab-prefetch: charge a deterministic "
-                        "per-unique-row storage latency on every disk "
-                        "read in BOTH arms (QD1 NVMe random-read "
-                        "model; sleep releases the GIL so overlap is "
-                        "honest) — the reproducible arm on boxes "
+                        "per-REQUEST storage service time on every "
+                        "disk read in BOTH arms (io.StorageModel; "
+                        "sleep releases the GIL so overlap is honest)."
+                        " The sync/mmap path issues one request per "
+                        "unique row serially (QD1); the parallel "
+                        "staging path issues one per coalesced extent "
+                        "from its reader pool, overlapped up to "
+                        "--storage-qd — the reproducible arm on boxes "
                         "whose hypervisor caches the artifact")
+    p.add_argument("--storage-qd", type=int, default=16,
+                   help="with --storage-latency-us: the modeled "
+                        "device's queue depth (requests it overlaps)")
+    p.add_argument("--io-workers", type=int, default=2,
+                   help="with --ab-prefetch: staging workers sharding "
+                        "each publication's unique-row set (ON arm)")
+    p.add_argument("--io-qd", type=int, default=16,
+                   help="with --ab-prefetch: the ExtentReader pool's "
+                        "queue depth (in-flight preadv requests)")
+    p.add_argument("--io-engine", default="auto",
+                   choices=("auto", "direct", "pread", "mmap"),
+                   help="with --ab-prefetch: ON-arm read engine "
+                        "(auto probes O_DIRECT, falls back to "
+                        "buffered preadv; mmap = the compat per-row "
+                        "fancy-index)")
     p.add_argument("--dup", type=float, default=8.0,
                    help="with --ab-dedup: duplicate factor "
                         "(batch / distinct ids per batch)")

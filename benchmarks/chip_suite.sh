@@ -7,8 +7,8 @@
 # evidence pipeline commits it with -f).
 #
 # Usage: sh benchmarks/chip_suite.sh [section ...]
-#   sections: verify bench dispatch sampler gather tiered offload e2e
-#             exchange mixed hetero micro ablate regress
+#   sections: verify bench dispatch sampler gather tiered offload io
+#             e2e exchange mixed hetero micro ablate regress
 #   default       = every section
 #   quick         = bench only (the metric of record; also warms the
 #                   compile cache for a later full sweep)
@@ -24,7 +24,7 @@ export QT_METRICS_JSONL
 SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-verify bench dispatch sampler gather tiered offload e2e exchange mixed hetero micro ablate regress}"
+SECTIONS="${*:-verify bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -86,6 +86,16 @@ if want tiered; then
     step python -u benchmarks/bench_feature.py --tiered 0.2 --rows 300000 --batch 20000 --iters 5 --prefetch
     step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5
     step python -u benchmarks/bench_feature.py --tiered 0.0 --rows 300000 --batch 20000 --iters 5 --prefetch
+fi
+
+# cold-tier parallel IO: the frontier-ahead prefetch A/B under the
+# deterministic queue-depth storage model (CPU is fine — the model is
+# the device; the hypervisor page cache cannot hide the win) — pins
+# QD-N staged-rows/s vs QD1 and end-to-end steps/s at cold 0.9, plus
+# the real-eviction regime for the fio-relative number on honest disks
+if want io; then
+    step env JAX_PLATFORMS=cpu python -u benchmarks/bench_feature.py --ab-prefetch --rows 120000 --dim 64 --batch 8000 --iters 6 --cold-fracs 0.5,0.9 --storage-latency-us 50 --storage-qd 16 --io-workers 2 --io-qd 16
+    step env JAX_PLATFORMS=cpu python -u benchmarks/bench_feature.py --ab-prefetch --rows 120000 --dim 64 --batch 8000 --iters 6 --cold-fracs 0.9
 fi
 
 # pinned_host cold tier: does the TPU compiler take pinned_host

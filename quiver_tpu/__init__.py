@@ -44,6 +44,7 @@ from .hetero_feature import HeteroFeature
 from .async_sampler import (AsyncNeighborSampler, AsyncCudaNeighborSampler,
                             sample_ahead)
 from .prefetch import ColdPrefetcher, StagingRing
+from .io import ExtentReader, StorageModel, plan_extents
 from .debug import show_tensor_info
 from .inference import layerwise_inference
 from .datasets import (GraphDataset, from_numpy_dir,
@@ -104,6 +105,9 @@ __all__ = [
     "sample_ahead",
     "ColdPrefetcher",
     "StagingRing",
+    "ExtentReader",
+    "StorageModel",
+    "plan_extents",
     "save_disk_tier",
     "load_disk_tier",
     "load_disk_tier_store",

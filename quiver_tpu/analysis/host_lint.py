@@ -37,9 +37,11 @@ from typing import Iterable, List, Optional
 from .findings import ERROR, Finding
 
 # resource constructors the lifecycle rule tracks: name -> whether the
-# type carries its OWN weakref.finalize (Pipeline does; see pipeline.py)
+# type carries its OWN weakref.finalize (Pipeline does — pipeline.py;
+# ExtentReader binds one to its pool+fds — io.py; a class storing
+# either must still define close() for deterministic shutdown)
 _RESOURCES = {"Thread": False, "ThreadPoolExecutor": False,
-              "Pipeline": True}
+              "Pipeline": True, "ExtentReader": True}
 
 _BLOCKING_ATTRS = ("block_until_ready", "device_get", "item", "tolist")
 

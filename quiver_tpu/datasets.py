@@ -312,13 +312,16 @@ def generate_synthetic_cold_dataset(out_dir: str, nodes: int = 1_000_000,
 def load_synthetic_cold_dataset(out_dir: str,
                                 prefetch_rows: Optional[int] = None,
                                 depth: int = 2,
-                                decode_staged: bool = True):
+                                decode_staged: bool = True,
+                                **prefetch_kwargs):
     """Rebuild a generated dataset as framework-native structures:
     ``(csr_topo, feature, meta)``. The :class:`~quiver_tpu.feature.
     Feature` holds ``hot_rows.npy`` in the HBM tier and the full row
     space on the mmap disk tier; ``prefetch_rows`` attaches the
     frontier-keyed cold prefetcher (``enable_cold_prefetch``) with that
-    ring capacity. The caller owns ``feature.close()``."""
+    ring capacity, and ``prefetch_kwargs`` forward to it (``workers``,
+    ``io_qd``, ... — the parallel-IO staging knobs). The caller owns
+    ``feature.close()``."""
     from .feature import DeviceConfig, Feature
     from .partition import load_disk_tier
 
@@ -335,5 +338,6 @@ def load_synthetic_cold_dataset(out_dir: str,
     store.set_mmap_file(**kwargs)
     if prefetch_rows:
         store.enable_cold_prefetch(prefetch_rows, depth=depth,
-                                   decode_staged=decode_staged)
+                                   decode_staged=decode_staged,
+                                   **prefetch_kwargs)
     return topo, store, meta

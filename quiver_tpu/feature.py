@@ -748,6 +748,14 @@ class Feature:
             vec[_m.PREFETCH_HIT_ROWS] = int(d[0])
             vec[_m.PREFETCH_SYNC_ROWS] = int(d[1])
             vec[_m.PREFETCH_STAGED_ROWS] = pf.drain_staged()
+            # the parallel-IO facts behind those staged rows (same
+            # since-last-metered-lookup attribution): extents issued,
+            # rows/bytes the device moved, observed queue-depth peak
+            io = pf.drain_io()
+            vec[_m.IO_EXTENTS] = int(io[0])
+            vec[_m.IO_READ_ROWS] = int(io[1])
+            vec[_m.IO_READ_BYTES] = int(min(io[2], 2**31 - 1))
+            vec[_m.IO_DEPTH_PEAK] = int(io[3])
         return rows, vec
 
     def prefetch(self, node_idx):
@@ -808,7 +816,10 @@ class Feature:
     # -- cold-tier (disk) prefetch ------------------------------------------
     def enable_cold_prefetch(self, capacity_rows: int = 65_536,
                              depth: int = 2, decode_staged: bool = True,
-                             wait_inflight: bool = True):
+                             wait_inflight: bool = True,
+                             workers: int = 1, io_qd: int = 16,
+                             io_cap_bytes: int = 1 << 20,
+                             io_engine: str = "auto", io_model=None):
         """Attach a frontier-keyed asynchronous prefetcher to the mmap
         disk tier (requires :meth:`set_mmap_file` first): publish a
         FUTURE batch's frontier with :meth:`stage_frontier` (or drive
@@ -818,7 +829,16 @@ class Feature:
         task still in flight (``wait_inflight`` — the read is already
         running, re-issuing it would pay the disk twice) and finally
         falls back to the synchronous read, counted
-        (``metrics.PREFETCH_SYNC_ROWS``), never wrong. Returns the
+        (``metrics.PREFETCH_SYNC_ROWS``), never wrong.
+
+        The staging reads are batched parallel IO: ``workers`` staging
+        workers shard each publication's unique-row set, and each
+        shard's rows read as coalesced extents at queue depth
+        ``io_qd`` through ``quiver_tpu.io.ExtentReader`` (``io_engine``
+        "auto" probes O_DIRECT and falls back to buffered preadv;
+        "mmap" keeps the per-row fancy-index compat path;
+        ``io_cap_bytes`` caps one request's size; ``io_model`` is the
+        bench's deterministic queue-depth device model). Returns the
         :class:`~quiver_tpu.prefetch.ColdPrefetcher` (re-attaching
         replaces — and closes — a previous one)."""
         if self.mmap_array is None or self.disk_map is None:
@@ -829,7 +849,9 @@ class Feature:
             self._cold_prefetch.close()
         self._cold_prefetch = ColdPrefetcher(
             self, capacity_rows, depth=depth,
-            decode_staged=decode_staged, wait_inflight=wait_inflight)
+            decode_staged=decode_staged, wait_inflight=wait_inflight,
+            workers=workers, io_qd=io_qd, io_cap_bytes=io_cap_bytes,
+            io_engine=io_engine, io_model=io_model)
         return self._cold_prefetch
 
     def stage_frontier(self, node_idx):

@@ -87,11 +87,15 @@ DEDUP_CALLS = 12      # dedup compactions recorded
 PREFETCH_HIT_ROWS = 13    # disk-tier rows served from the staging ring
 PREFETCH_SYNC_ROWS = 14   # disk-tier rows read synchronously (ring miss)
 PREFETCH_STAGED_ROWS = 15  # rows the cold prefetcher staged into the ring
+IO_EXTENTS = 16       # coalesced read requests the cold-IO path issued
+IO_READ_ROWS = 17     # disk rows those extents covered
+IO_READ_BYTES = 18    # bytes the storage device moved (saturates int32)
+IO_DEPTH_PEAK = 19    # peak in-flight read requests observed [max slot]
 
-NUM_COUNTERS = 16
+NUM_COUNTERS = 20
 
 #: slots merged with ``max`` across steps/shards; all others add
-MAX_SLOTS = (EXCH_BUCKET_MAX, EXCH_CAP)
+MAX_SLOTS = (EXCH_BUCKET_MAX, EXCH_CAP, IO_DEPTH_PEAK)
 
 SLOT_NAMES = {
     HOT_ROWS: "hot_rows", COLD_ROWS: "cold_rows",
@@ -104,6 +108,10 @@ SLOT_NAMES = {
     PREFETCH_HIT_ROWS: "prefetch_hit_rows",
     PREFETCH_SYNC_ROWS: "prefetch_sync_rows",
     PREFETCH_STAGED_ROWS: "prefetch_staged_rows",
+    IO_EXTENTS: "io_extents",
+    IO_READ_ROWS: "io_read_rows",
+    IO_READ_BYTES: "io_read_bytes",
+    IO_DEPTH_PEAK: "io_depth_peak",
 }
 
 _MAX_MASK_NP = np.zeros((NUM_COUNTERS,), bool)
@@ -231,6 +239,7 @@ def derive(counters) -> Dict[str, Optional[float]]:
         "prefetch_hit_rate": ratio(
             c[PREFETCH_HIT_ROWS],
             c[PREFETCH_HIT_ROWS] + c[PREFETCH_SYNC_ROWS]),
+        "io_coalescing_factor": ratio(c[IO_READ_ROWS], c[IO_EXTENTS]),
     }
 
 
@@ -462,6 +471,12 @@ class StepStats:
                 f"{fmt(d['prefetch_hit_rate'], pct=True)}  "
                 f"({c['prefetch_staged_rows']} rows staged, "
                 f"{c['prefetch_sync_rows']} sync fallbacks)")
+        if c["io_extents"]:
+            lines.append(
+                f"cold-tier IO: {c['io_extents']} extents, "
+                f"{fmt(d['io_coalescing_factor'])} rows/extent, "
+                f"{c['io_read_bytes'] / 1e6:.1f} MB read, "
+                f"depth peak {c['io_depth_peak']}")
         if "request" in s:
             r = s["request"]
             lines.insert(1, (

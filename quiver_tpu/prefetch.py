@@ -34,6 +34,16 @@ pipeline depth bounds in-flight staging work, and eviction is wrap-
 around overwrite — a long run's memory is constant no matter how many
 batches it publishes (``scripts/check_leak.py`` phase 8 pins it).
 
+Reads are BATCHED PARALLEL IO, not per-row page faults: the staging
+path plans coalesced ``(offset, length)`` extents over the sorted
+unique rows and issues them at queue depth 16-32 through
+``quiver_tpu.io.ExtentReader`` (O_DIRECT where the OS allows, buffered
+preadv elsewhere, mmap as the compat fallback), and ``workers=N``
+staging workers shard each publication's unique-row set — the NVMe
+sees a deep queue of sequential requests instead of one outstanding
+random read (ROADMAP frontier 3; the GIDS/direct-storage shape from
+2306.16384).
+
 Decoded vs raw staging: by default the ring holds *decoded* rows
 (``decode_staged=True``) so the critical-path ``take`` is a pure slice
 copy and the int8 dequant FMA runs on the prefetch thread too — the
@@ -46,11 +56,17 @@ synchronous read (the decode is the same numpy expression
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
+import weakref
 
 import numpy as np
 
+from .io import coalescing_factor
 from .ops.dedup import unique_np
+
+_log = logging.getLogger("quiver_tpu.prefetch")
 
 
 def evict_file_cache(path: str, mapped=None) -> bool:
@@ -122,16 +138,25 @@ class StagingRing:
         return int((self.ids >= 0).sum())
 
     def missing(self, ids: np.ndarray) -> np.ndarray:
-        """The subset of (unique) ``ids`` not currently staged."""
+        """The subset of (unique) ``ids`` not currently staged.
+        ADVISORY under concurrent stagers: another worker may stage
+        some of these between this read and a later :meth:`stage` —
+        which re-checks under its own lock, so the race costs at most
+        a duplicate read, never a corrupt ring."""
         with self._lock:
             return ids[self._slot_of[ids] < 0]
 
     def stage(self, ids: np.ndarray, rows: np.ndarray, scale=None,
               zero=None) -> int:
         """Stage ``rows`` (one per id) into the next slots, evicting
-        whatever the wrap lands on. ``ids`` must be unique and not
-        currently staged (use :meth:`missing`) and at most ``capacity``
-        long — the single staging worker guarantees both."""
+        whatever the wrap lands on. ``ids`` must be unique and at most
+        ``capacity`` long (truncate before staging). The
+        missing-filter runs HERE, under the same lock as the slot
+        assignment: with several staging workers feeding one ring, the
+        check-then-act ``missing()`` → ``stage()`` pair would
+        otherwise double-stage a row both workers saw as absent —
+        leaving a stale slot whose later eviction clears the LIVE
+        slot's index entry. Returns the rows actually staged."""
         k = int(ids.shape[0])
         if not k:
             return 0
@@ -139,6 +164,16 @@ class StagingRing:
             raise ValueError(f"staging {k} rows into a {self.capacity}"
                              "-slot ring (truncate before staging)")
         with self._lock:
+            fresh = self._slot_of[ids] < 0
+            if not fresh.all():
+                ids = ids[fresh]
+                rows = rows[fresh]
+                if scale is not None:
+                    scale = scale[fresh]
+                    zero = zero[fresh]
+                k = int(ids.shape[0])
+                if not k:
+                    return 0
             slots = (self._cursor + np.arange(k)) % self.capacity
             evicted = self.ids[slots]
             self._slot_of[evicted[evicted >= 0]] = -1
@@ -186,10 +221,14 @@ class ColdPrefetcher:
 
     def __init__(self, feature, capacity_rows: int, depth: int = 2,
                  decode_staged: bool = True,
-                 wait_inflight: bool = True):
+                 wait_inflight: bool = True, workers: int = 1,
+                 io_qd: int = 16, io_cap_bytes: int = 1 << 20,
+                 io_engine: str = "auto", io_model=None):
         if feature.mmap_array is None or feature.disk_map is None:
             raise ValueError("cold-tier prefetch needs an mmap disk "
                              "tier (call set_mmap_file first)")
+        if workers < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
         from .pipeline import Pipeline
         self._feature = feature
         mm = feature.mmap_array
@@ -206,6 +245,31 @@ class ColdPrefetcher:
         self._ring = StagingRing(capacity_rows, mm.shape[1], ring_dtype,
                                  mm.shape[0], sidecar_dtype)
         self._pipe = Pipeline(depth=depth, name="quiver-cold-prefetch")
+        # the parallel-IO read path (quiver_tpu.io): coalesced extents
+        # at queue depth io_qd via a preadv reader pool. None when the
+        # tier is not a plain file region (or io_engine="mmap") — the
+        # per-row mmap fancy-index stays as the compat fallback.
+        self.workers = int(workers)
+        self._reader = None
+        if io_engine != "mmap":
+            from .io import ExtentReader
+            self._reader = ExtentReader.from_array(
+                mm, qd=io_qd, io_cap_bytes=io_cap_bytes,
+                engine=io_engine, model=io_model)
+        # N staging workers shard a publication's unique-row set and
+        # feed the one ring concurrently (stage() dedups under its own
+        # lock); the pool exists only past workers=1 — the Pipeline
+        # worker itself stages the single-worker path.
+        self._stagers = None
+        if self.workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(max_workers=self.workers,
+                                      thread_name_prefix="qt-stager")
+            self._stagers = pool
+            # GC safety net bound to the pool, not self: an abandoned
+            # prefetcher must not strand its staging threads
+            self._stagers_finalizer = weakref.finalize(
+                self, pool.shutdown, wait=False)
         # cumulative counters, drained as deltas by the metrics path:
         # [hit rows, sync-fallback rows, staged rows]
         self._counters = np.zeros(3, np.int64)
@@ -213,6 +277,15 @@ class ColdPrefetcher:
         self._published = 0
         self._dropped = 0
         self._batches_staged = 0
+        # frontier rows dropped because one publication exceeded the
+        # whole ring — counted and logged ONCE (no silent caps)
+        self._truncated = 0
+        self._warned_truncate = False
+        # per-interval IO facts [extents, rows read, bytes, depth peak]
+        # (peak merges with max); _io_undrained feeds the metered
+        # lookup's counter slots, _io_total feeds stats()
+        self._io_undrained = np.zeros(4, np.int64)
+        self._io_total = np.zeros(4, np.int64)
         # wait_inflight: a lookup that misses while a staging task is
         # STILL RUNNING waits for it and re-takes, instead of re-paying
         # the disk read synchronously for rows whose read is already in
@@ -223,8 +296,10 @@ class ColdPrefetcher:
         self._inflight: list = []
         # observe_into's last-seen cumulative counts, so repeated calls
         # feed the telemetry hub INTERVAL deltas (per-window hit rate),
-        # not an ever-flattening lifetime average
-        self._hub_last = np.zeros(5, np.int64)
+        # not an ever-flattening lifetime average; _hub_t is the
+        # interval's time base for the staged-rows/s series
+        self._hub_last = np.zeros(6, np.int64)
+        self._hub_t = None
         self._lock = threading.Lock()
 
     # -- publishing ---------------------------------------------------------
@@ -275,11 +350,66 @@ class ColdPrefetcher:
         if new.shape[0] > self._ring.capacity:
             # a frontier wider than the whole ring: stage the first
             # capacity rows (staging more would evict rows staged
-            # moments earlier in this same call)
+            # moments earlier in this same call) — counted, and logged
+            # ONCE so an undersized ring is never a silent cap
+            dropped = int(new.shape[0]) - self._ring.capacity
             new = new[: self._ring.capacity]
+            with self._lock:
+                self._truncated += dropped
+                warn = not self._warned_truncate
+                self._warned_truncate = True
+            if warn:
+                _log.warning(
+                    "cold-prefetch frontier wider than the staging ring "
+                    "(%d unique rows > %d slots): %d rows dropped this "
+                    "publication; counted in stats()['truncated_rows'] "
+                    "(this warning fires once — grow capacity_rows to "
+                    "cover the frontier)", int(uniq.shape[0]),
+                    self._ring.capacity, dropped)
         if not new.shape[0]:
             return 0
-        rows = np.asarray(f.mmap_array[new])         # THE disk read
+        # `new` is sorted (unique_np sorts; missing() preserves order):
+        # contiguous shards keep adjacent rows together, so sharding
+        # never splits a coalescible extent across workers except at
+        # the w-1 shard seams
+        w = min(self.workers, int(new.shape[0]))
+        stagers = self._stagers      # one read: close() may null it
+        if w > 1 and stagers is not None:
+            futs = [stagers.submit(self._stage_shard, shard)
+                    for shard in np.array_split(new, w)]
+            staged = sum(f.result() for f in futs)
+        else:
+            staged = self._stage_shard(new)
+        with self._lock:
+            self._batches_staged += 1
+        return staged
+
+    def _stage_shard(self, new: np.ndarray) -> int:
+        """Read + decode + stage one shard of a publication's unique
+        disk rows (runs on a staging worker; the ring's own lock makes
+        concurrent shards safe). The read goes through the deep-queue
+        :class:`~quiver_tpu.io.ExtentReader` when the tier is a plain
+        file region, else the mmap fancy-index compat path."""
+        f = self._feature
+        reader = self._reader        # one read: close() may null it
+        rows = None
+        if reader is not None and not reader.closed:
+            try:
+                rows, io = reader.read_rows(new)         # THE disk read
+            except RuntimeError:
+                # close(wait=False) shut the reader under a still-
+                # running staging task: the mmap read below is still
+                # exact — degrade, don't kill the publication's Future
+                rows = None
+            else:
+                with self._lock:
+                    for vec in (self._io_undrained, self._io_total):
+                        vec[0] += io["extents"]
+                        vec[1] += io["rows"]
+                        vec[2] += io["bytes"]
+                        vec[3] = max(vec[3], io["depth_peak"])
+        if rows is None:
+            rows = np.asarray(f.mmap_array[new])         # compat read
         scale = zero = None
         if self._quantized:
             scale = np.asarray(f.disk_scale[new])
@@ -293,7 +423,6 @@ class ColdPrefetcher:
         with self._lock:
             self._counters[2] += staged
             self._staged_undrained += staged
-            self._batches_staged += 1
         return staged
 
     # -- the lookup-side read -----------------------------------------------
@@ -363,21 +492,35 @@ class ColdPrefetcher:
         """Feed a ``telemetry.TelemetryHub`` the since-last-call DELTAS
         of this prefetcher's signals: ``prefetch_hit_rate`` (hits over
         hits+syncs in the interval — the series the hub's drop detector
-        watches), ``prefetch_staged_rows``, and
-        ``prefetch_drop_rate`` (publications dropped at a saturated
-        staging pipeline). Call it wherever the loop already takes a
-        breath (per epoch, per report); returns the delta dict."""
+        watches), ``prefetch_staged_rows``,
+        ``cold_staged_rows_per_s`` (the interval's staging THROUGHPUT —
+        the curve ``replan()``'s ``io_workers`` advisor reads),
+        ``prefetch_truncated_rows`` (frontier rows dropped at an
+        undersized ring), and ``prefetch_drop_rate`` (publications
+        dropped at a saturated staging pipeline). Call it wherever the
+        loop already takes a breath (per epoch, per report); returns
+        the delta dict."""
+        t_now = time.monotonic()
         with self._lock:
             now = np.array([*(int(v) for v in self._counters),
-                            self._published, self._dropped], np.int64)
+                            self._published, self._dropped,
+                            self._truncated], np.int64)
             d = now - self._hub_last
             self._hub_last = now
-        hit, sync, staged, pub, drop = (int(v) for v in d)
+            dt, self._hub_t = (None if self._hub_t is None
+                               else t_now - self._hub_t), t_now
+        hit, sync, staged, pub, drop, trunc = (int(v) for v in d)
         out = {"hit_rows": hit, "sync_rows": sync, "staged_rows": staged,
-               "published": pub, "dropped": drop}
+               "published": pub, "dropped": drop,
+               "truncated_rows": trunc}
         if hit + sync:
             hub.observe("prefetch_hit_rate", hit / (hit + sync))
         hub.observe("prefetch_staged_rows", staged)
+        if dt is not None and dt > 0:
+            out["staged_rows_per_s"] = staged / dt
+            hub.observe("cold_staged_rows_per_s", staged / dt)
+        if trunc:
+            hub.observe("prefetch_truncated_rows", trunc)
         if pub:
             hub.observe("prefetch_drop_rate", drop / pub)
         return out
@@ -391,28 +534,62 @@ class ColdPrefetcher:
             staged, self._staged_undrained = self._staged_undrained, 0
         return staged
 
+    def drain_io(self) -> np.ndarray:
+        """IO facts since the last drain — ``[extents, rows_read,
+        bytes, depth_peak]`` int64 — the per-batch figures the metered
+        lookup writes into the ``io_*`` counter slots (the peak resets
+        each drain: it is a per-interval observation, merged with max
+        across steps by the slot semantics)."""
+        with self._lock:
+            vals = self._io_undrained.copy()
+            self._io_undrained[:] = 0
+        return vals
+
     def stats(self) -> dict:
         """Telemetry snapshot: publication and row counts, the derived
-        hit rate, ring occupancy, and the staging pipeline's stats."""
+        hit rate, ring occupancy, truncation, the parallel-IO facts
+        (engine, extents, coalescing factor, bytes, observed depth
+        peak), and the staging pipeline's stats."""
         with self._lock:
             hit, sync, staged = (int(v) for v in self._counters)
-            pub, drop, bat = (self._published, self._dropped,
-                              self._batches_staged)
+            pub, drop, bat, trunc = (self._published, self._dropped,
+                                     self._batches_staged,
+                                     self._truncated)
+            io_ext, io_rows, io_bytes, io_peak = (
+                int(v) for v in self._io_total)
         total = hit + sync
         return {
             "published": pub, "dropped": drop, "batches_staged": bat,
             "hit_rows": hit, "sync_rows": sync, "staged_rows": staged,
+            "truncated_rows": trunc,
             "hit_rate": (hit / total) if total else None,
             "capacity": self._ring.capacity, "filled": self._ring.filled,
+            "workers": self.workers,
+            "io": {
+                "engine": (self._reader.engine
+                           if self._reader is not None else "mmap"),
+                "extents": io_ext, "rows_read": io_rows,
+                "bytes_read": io_bytes, "depth_peak": io_peak,
+                "coalescing_factor": coalescing_factor(io_rows, io_ext),
+            },
             "pipeline": self._pipe.stats(),
         }
 
     # -- lifecycle ----------------------------------------------------------
     def close(self, wait: bool = True):
-        """Stop the staging worker (idempotent). Queued publications
-        are cancelled, the in-flight one finishes, and the worker
-        thread is joined (``wait=True``) — nothing is stranded."""
+        """Stop the staging machinery (idempotent): queued publications
+        are cancelled, the in-flight one finishes, the pipeline worker
+        is joined (``wait=True``), then the staging pool and the
+        extent reader's thread pool shut down — no stranded reader
+        threads (scripts/check_leak.py phase 8 pins it)."""
         self._pipe.close(wait=wait)
+        pool, self._stagers = self._stagers, None
+        if pool is not None:
+            self._stagers_finalizer.detach()
+            pool.shutdown(wait=wait)
+        reader, self._reader = self._reader, None
+        if reader is not None:
+            reader.close(wait=wait)
 
     @property
     def closed(self) -> bool:

@@ -77,7 +77,7 @@ DETECTOR_NAMES = ("mean_shift", "page_hinkley", "spike")
 #: advice record keys :meth:`TelemetryHub.replan` can emit (same lint
 #: contract as ``DETECTOR_NAMES``)
 ADVICE_KEYS = ("hot_capacity", "exchange_cap", "dedup_budget",
-               "batch_cap", "max_wait_ms")
+               "batch_cap", "max_wait_ms", "io_workers")
 
 
 # -- the per-metric ring time-series ----------------------------------------
@@ -305,6 +305,10 @@ class PlanContext:
       ``dedup_gather`` run with.
     - ``batch_cap`` / ``max_wait_ms`` / ``target_p99_ms``: the serving
       knobs (``ServeConfig``).
+    - ``io_workers`` / ``io_qd``: the cold tier's parallel-IO staging
+      deployment (``Feature.enable_cold_prefetch``) — how many staging
+      workers shard each publication, and the reader pool's queue
+      depth (the ceiling any worker recommendation respects).
     - ``slack``: the proportional headroom every recommendation carries
       (the planners' own default 1.25).
     """
@@ -320,6 +324,8 @@ class PlanContext:
                  batch_cap: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  target_p99_ms: Optional[float] = None,
+                 io_workers: Optional[int] = None,
+                 io_qd: Optional[int] = None,
                  slack: float = 1.25):
         self.hot_capacity = hot_capacity
         self.total_rows = total_rows
@@ -333,6 +339,8 @@ class PlanContext:
         self.batch_cap = batch_cap
         self.max_wait_ms = max_wait_ms
         self.target_p99_ms = target_p99_ms
+        self.io_workers = io_workers
+        self.io_qd = io_qd
         self.slack = float(slack)
 
 
@@ -626,9 +634,14 @@ class TelemetryHub:
     def ingest_prefetch(self, stats: dict) -> None:
         """Series points from a ``ColdPrefetcher.stats()``-shaped dict
         (prefer ``ColdPrefetcher.observe_into(hub)``, which feeds
-        interval deltas instead of cumulative totals)."""
+        interval deltas instead of cumulative totals — including the
+        ``cold_staged_rows_per_s`` curve the ``io_workers`` advisor
+        reads, which needs an interval time base this path lacks)."""
         self.observe("prefetch_hit_rate", stats.get("hit_rate"))
         self.observe("prefetch_staged_rows", stats.get("staged_rows"))
+        trunc = stats.get("truncated_rows")
+        if trunc:
+            self.observe("prefetch_truncated_rows", trunc)
 
     # -- reading -------------------------------------------------------------
     def counters(self) -> np.ndarray:
@@ -682,7 +695,7 @@ class TelemetryHub:
             for fn in (self._advise_hot_capacity,
                        self._advise_exchange_cap,
                        self._advise_dedup_budget, self._advise_batch_cap,
-                       self._advise_max_wait):
+                       self._advise_max_wait, self._advise_io_workers):
                 rec = fn(plan)
                 if rec is not None:
                     out.append(rec)
@@ -856,6 +869,49 @@ class TelemetryHub:
             "observed": {"request_p99_ms": round(p99["mean"], 2),
                          "target_p99_ms": target},
             "reason": why,
+        }
+
+    def _advise_io_workers(self, plan: PlanContext) -> Optional[dict]:
+        """Size the cold tier's staging parallelism from the OBSERVED
+        staged-rows/s curve (``ColdPrefetcher.observe_into`` feeds the
+        ``cold_staged_rows_per_s`` series): when lookups still pay
+        sync fallbacks (hit rate short of ~0.9) while the staging
+        throughput has PLATEAUED (recent p95 within 15% of the window
+        mean — more publications are not lifting the curve), the
+        pipeline is IO-bound at its current width: advise doubling
+        ``workers``, capped at the reader pool's ``io_qd`` (more
+        stagers than device queue slots just queue behind each other).
+        A rising curve or a healthy hit rate advises nothing — the
+        current width is still delivering."""
+        if plan.io_workers is None:
+            return None
+        hit = self._stats("prefetch_hit_rate")
+        thr = self._stats("cold_staged_rows_per_s")
+        if hit is None or thr is None or thr["mean"] <= 0:
+            return None
+        if hit["mean"] >= 0.9:
+            return None
+        plateau = thr["p95"] <= 1.15 * thr["mean"]
+        if not plateau:
+            return None
+        cur = max(int(plan.io_workers), 1)
+        cap = int(plan.io_qd) if plan.io_qd else 2 * cur
+        rec = min(2 * cur, cap)
+        if rec <= cur:
+            return None
+        return {
+            "key": "io_workers",
+            "current": cur,
+            "recommended": int(rec),
+            "observed": {
+                "prefetch_hit_rate": round(hit["mean"], 4),
+                "staged_rows_per_s_mean": round(thr["mean"], 1),
+                "staged_rows_per_s_p95": round(thr["p95"], 1)},
+            "reason": (f"hit rate {hit['mean']:.2f} with staging "
+                       f"throughput flat at ~{thr['mean']:.0f} rows/s: "
+                       f"IO-bound at {cur} worker(s); "
+                       f"{rec} shards the unique-row set wider "
+                       f"(<= io_qd={cap})"),
         }
 
     # -- rendering -----------------------------------------------------------

@@ -150,7 +150,11 @@ def is_skipped(rec):
 #: higher-is-better (rows/s; the hit rate is a fraction), judged with
 #: the same latest-vs-best-prior rule. Absent keys (older rounds
 #: predate them) simply contribute no point.
-SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate")
+#: ``cold_staged_rows_per_s`` (parallel-IO staging throughput) joins
+#: in round 13 — the QD/coalescing win is regression-tracked from
+#: the round that shipped it.
+SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
+               "cold_staged_rows_per_s")
 
 
 def _points(rec):

@@ -49,13 +49,19 @@ than the span volume so the wrap actually happens, and asserts the
 retained span count never exceeds capacity (bounded memory no matter
 how long the server runs) and that the Perfetto export round-trips.
 
-Phase 8 pins the COLD-TIER PREFETCH path: 50 frontier-ahead prefetched
-disk-tier steps (publish batch i+1, gather batch i, jitted compute) —
-zero executable growth, zero recompiles through the StepStats watch,
-live arrays flat, and the staging ring bounded at its capacity (it is
-sized BELOW the distinct cold rows the loop touches, so the wraparound
-eviction path is what gets pinned — and the ring buffers must be the
-SAME objects at the end: eviction overwrites, never reallocates).
+Phase 8 pins the COLD-TIER PREFETCH path, PARALLEL-IO staging
+included: 50 frontier-ahead prefetched disk-tier steps (publish batch
+i+1, gather batch i, jitted compute) with ``workers=2`` staging
+workers sharding each publication over the deep-queue extent reader
+(``quiver_tpu/io.py``) — zero executable growth, zero recompiles
+through the StepStats watch, live arrays flat, and the staging ring
+bounded at its capacity (it is sized BELOW the distinct cold rows the
+loop touches, so the wraparound eviction path is what gets pinned
+UNDER CONCURRENT STAGERS — and the ring buffers must be the SAME
+objects at the end: eviction overwrites, never reallocates). After
+``close()``, no reader-pool or stager thread survives — the staging
+machinery is three thread owners (pipeline worker, stager pool,
+reader pool) and all three must reap deterministically.
 
 Phase 9 pins the TELEMETRY HUB: 50 metered lookups + donated metered
 train steps with a ``telemetry.TelemetryHub`` fully live — change-point
@@ -74,6 +80,7 @@ import gc
 import os
 import resource
 import sys
+import threading
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -570,8 +577,11 @@ def main():
     save_disk_tier(cfeat, np.arange(cn, dtype=np.int64), ctmp,
                    dtype_policy="int8")
     cstore, _cmeta = load_disk_tier_store(ctmp, hot_rows=ccache,
-                                          prefetch_rows=ccap)
+                                          prefetch_rows=ccap,
+                                          workers=2, io_qd=4)
     cpf = cstore._cold_prefetch
+    assert cpf.workers == 2 and cpf._stagers is not None, \
+        "phase premise: parallel staging (workers>=2) must be active"
     ring_rows_buf = cpf._ring.rows          # identity pinned below
     ring_index_buf = cpf._ring._slot_of
     cw = jnp.asarray(rng.standard_normal((cdim, cdim))
@@ -647,11 +657,19 @@ def main():
         "phase premise: the loop must exercise BOTH ring hits and " \
         "sync fallbacks (capacity < working set)"
     assert snap["counters"]["prefetch_hit_rows"] == pstats["hit_rows"]
+    assert pstats["io"]["extents"] > 0, \
+        "phase premise: staging must go through the extent reader " \
+        "(parallel-IO path), not the mmap compat fallback"
     cstore.close()
     assert cpf.closed, "close() left the prefetch worker running"
+    stranded = [t.name for t in threading.enumerate()
+                if t.name.startswith(("qt-io-reader", "qt-stager"))]
+    assert not stranded, \
+        f"close() stranded staging/reader threads: {stranded}"
     shutil.rmtree(ctmp, ignore_errors=True)
     print("no leak detected (phase 8: frontier-ahead cold-tier "
-          "prefetch, bounded staging ring)")
+          "prefetch, workers=2 parallel-IO staging, bounded ring, "
+          "no stranded reader threads)")
 
     # ---- phase 9: telemetry hub + detectors + advisor live ----
     # The observe/decide layer must be free: lazy counter folds, ring

@@ -78,6 +78,9 @@ def test_bench_cpu_smoke_json_contract(tmp_path):
     assert out["cold_rows_per_s"] > 0
     assert 0.5 < out["prefetch_hit_rate"] <= 1.0
     assert out["prefetch_staged_rows_per_batch"] > 0
+    # staging throughput through the parallel-IO extent reader
+    # (workers=2) — the third bench_regress trajectory group
+    assert out["cold_staged_rows_per_s"] > 0
     assert out["vs_baseline"] is None
     assert "error" not in out
     # the same record also landed in the structured metrics log

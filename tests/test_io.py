@@ -1,0 +1,536 @@
+"""Parallel-IO cold-tier reads (quiver_tpu/io.py) — tier-1 pins.
+
+The contract: extent planning is exact host math (adjacent-row merge,
+IO-size-cap split, O_DIRECT alignment rounding), the
+:class:`ExtentReader` is BIT-IDENTICAL to the mmap fancy-index on the
+same file (every engine, quantized artifacts included), the
+:class:`StagingRing` stays consistent under CONCURRENT stagers (the
+``workers=N`` path), a frontier wider than the ring is counted in a
+``truncated`` stat and logged once (no silent caps), the deterministic
+queue-depth model makes QD-N staging >= 3x the QD1 mmap path (the
+acceptance pin the bench A/B carries at scale), the new ``io_*``
+metrics slots flow through the metered lookup, and ``replan()``
+advises ``io_workers`` from the observed staged-rows/s curve.
+"""
+
+import logging
+import os
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu import metrics as qm
+from quiver_tpu.io import (ExtentReader, StorageModel, align_extent,
+                           coalescing_factor, plan_extents)
+from quiver_tpu.partition import load_disk_tier, save_disk_tier
+from quiver_tpu.prefetch import StagingRing
+
+N, DIM, CACHE = 600, 12, 200
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    """One int8 disk-tier artifact (identity map) + fp32 source."""
+    rng = np.random.default_rng(7)
+    feat = rng.standard_normal((N, DIM)).astype(np.float32)
+    d = str(tmp_path_factory.mktemp("io") / "disk")
+    save_disk_tier(feat, np.arange(N, dtype=np.int64), d,
+                   dtype_policy="int8")
+    kwargs, meta = load_disk_tier(d)
+    return d, kwargs, meta, feat
+
+
+class TestPlanExtents:
+    def test_empty_and_single(self):
+        assert plan_extents(np.array([], np.int64), 8).shape == (0, 2)
+        np.testing.assert_array_equal(
+            plan_extents(np.array([42]), 8), [[42, 1]])
+
+    def test_adjacent_rows_merge(self):
+        np.testing.assert_array_equal(
+            plan_extents(np.array([3, 4, 5, 9, 10, 20]), 8),
+            [[3, 3], [9, 2], [20, 1]])
+
+    def test_all_contiguous_is_one_extent(self):
+        np.testing.assert_array_equal(
+            plan_extents(np.arange(100), 8, io_cap_bytes=8 * 100),
+            [[0, 100]])
+
+    def test_none_contiguous_is_one_each(self):
+        rows = np.arange(0, 40, 2)
+        ext = plan_extents(rows, 8)
+        assert ext.shape == (rows.size, 2)
+        assert (ext[:, 1] == 1).all()
+
+    def test_io_cap_splits_long_runs(self):
+        # cap 64 bytes at 4 B/row = 16 rows per request
+        ext = plan_extents(np.arange(100), 4, io_cap_bytes=64)
+        assert (ext[:, 1] <= 16).all()
+        assert ext[:, 1].sum() == 100
+        np.testing.assert_array_equal(ext[0], [0, 16])
+        np.testing.assert_array_equal(ext[-1], [96, 4])
+
+    def test_cap_below_row_bytes_still_one_row_per_request(self):
+        ext = plan_extents(np.arange(5), row_bytes=100, io_cap_bytes=10)
+        assert (ext[:, 1] == 1).all() and ext.shape[0] == 5
+
+    def test_row_counts_cover_input_positions(self):
+        rng = np.random.default_rng(0)
+        rows = np.unique(rng.integers(0, 5000, 700))
+        ext = plan_extents(rows, 24, io_cap_bytes=240)
+        assert int(ext[:, 1].sum()) == rows.size
+        # reassemble: extent i covers positions [cum, cum+n)
+        rebuilt = np.concatenate(
+            [np.arange(s, s + c) for s, c in ext])
+        np.testing.assert_array_equal(rebuilt, rows)
+
+    def test_unsorted_or_duplicate_rows_raise(self):
+        with pytest.raises(ValueError, match="sorted"):
+            plan_extents(np.array([5, 3]), 8)
+        with pytest.raises(ValueError, match="sorted"):
+            plan_extents(np.array([3, 3]), 8)
+
+
+class TestAlignExtent:
+    def test_already_aligned_is_identity(self):
+        assert align_extent(8192, 4096, 4096) == (8192, 4096, 0)
+
+    def test_rounds_offset_down_and_length_up(self):
+        a_off, a_len, head = align_extent(5000, 300, 4096)
+        assert a_off == 4096 and head == 904
+        assert a_len == 4096 and a_len % 4096 == 0
+        assert a_off + a_len >= 5000 + 300
+
+    def test_spanning_a_boundary_grows_length(self):
+        a_off, a_len, head = align_extent(4000, 200, 4096)
+        assert (a_off, head) == (0, 4000)
+        assert a_len == 8192            # 4000+200 crosses one block
+
+    def test_bad_alignment_raises(self):
+        with pytest.raises(ValueError, match="alignment"):
+            align_extent(0, 10, 0)
+
+    def test_coalescing_factor(self):
+        assert coalescing_factor(100, 10) == pytest.approx(10.0)
+        assert coalescing_factor(0, 0) is None
+
+
+class TestExtentReader:
+    @pytest.fixture(scope="class")
+    def mm_file(self, tmp_path_factory):
+        rng = np.random.default_rng(1)
+        arr = rng.integers(-128, 127, (2000, 24)).astype(np.int8)
+        p = str(tmp_path_factory.mktemp("rd") / "rows.npy")
+        np.save(p, arr)
+        return p, arr
+
+    @pytest.mark.parametrize("engine", ["auto", "pread"])
+    def test_bit_identity_with_mmap(self, mm_file, engine, rng):
+        p, arr = mm_file
+        mm = np.load(p, mmap_mode="r")
+        r = ExtentReader.from_array(mm, qd=4, io_cap_bytes=512,
+                                    engine=engine)
+        try:
+            for rows in (np.unique(rng.integers(0, 2000, 300)),
+                         np.arange(100, 164),        # one run
+                         np.array([0]), np.array([1999]),
+                         np.array([], np.int64)):
+                out, st = r.read_rows(rows)
+                np.testing.assert_array_equal(out, arr[rows])
+                assert st["rows"] == rows.size
+                assert (st["extents"] > 0) == (rows.size > 0)
+        finally:
+            r.close()
+
+    def test_modeled_reader_same_bytes_modeled_depth(self, mm_file, rng):
+        p, arr = mm_file
+        mm = np.load(p, mmap_mode="r")
+        r = ExtentReader.from_array(
+            mm, qd=8, model=StorageModel(1, qd=8))
+        try:
+            rows = np.unique(rng.integers(0, 2000, 200))
+            out, st = r.read_rows(rows)
+            np.testing.assert_array_equal(out, arr[rows])
+            assert st["depth_peak"] == min(8, st["extents"])
+        finally:
+            r.close()
+
+    def test_from_array_refuses_non_file_arrays(self):
+        assert ExtentReader.from_array(np.zeros((4, 4))) is None
+        assert ExtentReader.from_array(np.zeros(16)) is None
+
+    def test_from_array_refuses_memmap_views(self, mm_file):
+        # a slice inherits the parent's .offset while its data starts
+        # elsewhere — offset math would return the PARENT's rows,
+        # silently shifted
+        p, _ = mm_file
+        mm = np.load(p, mmap_mode="r")
+        assert ExtentReader.from_array(mm[2:]) is None
+        assert ExtentReader.from_array(mm[:100]) is None
+
+    def test_forced_direct_failure_is_loud(self, tmp_path):
+        # tmpfs (/dev/shm) accepts the O_DIRECT open then fails the
+        # probe read: a FORCED engine must raise, not silently hand
+        # the caller the QD1 compat path under a 'direct' label
+        shm = "/dev/shm"
+        if not os.path.isdir(shm):
+            pytest.skip("no tmpfs mount to provoke O_DIRECT failure")
+        p = os.path.join(shm, f"qt_io_direct_{os.getpid()}.npy")
+        np.save(p, np.zeros((16, 4), np.int8))
+        try:
+            mm = np.load(p, mmap_mode="r")
+            try:
+                r = ExtentReader.from_array(mm, engine="direct")
+            except OSError:
+                pass                       # the loud path: correct
+            else:
+                # some kernels DO support O_DIRECT on tmpfs: then the
+                # reader must really be direct, not a silent fallback
+                assert r is not None and r.engine == "direct"
+                r.close()
+        finally:
+            os.unlink(p)
+
+    def test_from_array_through_a_forwarding_wrapper(self, mm_file):
+        # the bench's ModeledLatencyMmap pattern: attribute access
+        # forwards to the wrapped memmap
+        p, arr = mm_file
+
+        class Wrap:
+            def __init__(self, mm):
+                self._mm = mm
+
+            def __getattr__(self, name):
+                return getattr(self._mm, name)
+
+        r = ExtentReader.from_array(Wrap(np.load(p, mmap_mode="r")),
+                                    qd=2)
+        assert r is not None
+        out, _ = r.read_rows(np.arange(10))
+        np.testing.assert_array_equal(out, arr[:10])
+        r.close()
+
+    def test_close_is_idempotent_and_read_after_close_raises(
+            self, mm_file):
+        p, _ = mm_file
+        r = ExtentReader.from_array(np.load(p, mmap_mode="r"), qd=2)
+        r.close()
+        r.close()
+        assert r.closed
+        with pytest.raises(RuntimeError, match="closed"):
+            r.read_rows(np.arange(4))
+
+    def test_close_reaps_reader_threads(self, mm_file):
+        p, _ = mm_file
+        r = ExtentReader.from_array(np.load(p, mmap_mode="r"), qd=3)
+        r.read_rows(np.arange(0, 600, 2))     # spin the pool up
+        r.close()
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("qt-io-reader")
+                    and t.is_alive()]
+
+
+class TestStorageModel:
+    def test_deep_queue_beats_serial(self):
+        # the same 16 requests: serial QD1 pays 16 x service, a deep
+        # issuer drains at qd=8 — the whole point of the model
+        serial = StorageModel(2000, qd=8)
+        t0 = time.perf_counter()
+        serial.request(n=16)
+        t_serial = time.perf_counter() - t0
+        deep = StorageModel(2000, qd=8)
+        t0 = time.perf_counter()
+        deep.request_deep(16)
+        t_deep = time.perf_counter() - t0
+        assert t_serial >= 0.9 * 16 * 2000e-6
+        assert t_deep < t_serial / 2
+        assert serial.requests == deep.requests == 16
+
+    def test_concurrent_deep_callers_share_the_device(self):
+        # two callers' virtual clocks serialize on the shared device:
+        # aggregate time ~= total work at the device rate, not half
+        m = StorageModel(1000, qd=4)
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=m.request_deep, args=(20,))
+              for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # 40 requests at 1ms/4 = 10ms device time (+ fill + slop)
+        assert time.perf_counter() - t0 >= 0.009
+
+    def test_qd_validation(self):
+        with pytest.raises(ValueError, match="queue depth"):
+            StorageModel(10, qd=0)
+
+
+class TestStagingRingConcurrent:
+    def test_concurrent_stagers_keep_the_ring_consistent(self, rng):
+        total, cap, dim = 500, 64, 4
+        ring = StagingRing(cap, dim, np.float32, total)
+        src = rng.standard_normal((total, dim)).astype(np.float32)
+        errs = []
+
+        def stager(seed):
+            r = np.random.default_rng(seed)
+            for _ in range(30):
+                ids = np.unique(r.integers(0, total, 40))
+                ids = ring.missing(ids)[:cap]     # advisory, racy
+                if ids.size:
+                    try:
+                        ring.stage(ids, src[ids])
+                    except Exception as e:        # pragma: no cover
+                        errs.append(e)
+
+        ts = [threading.Thread(target=stager, args=(s,))
+              for s in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert ring.filled <= cap
+        # index <-> slots bijective where occupied, rows exact
+        live = np.flatnonzero(ring._slot_of >= 0)
+        slots = ring._slot_of[live]
+        assert np.unique(slots).size == slots.size
+        np.testing.assert_array_equal(ring.ids[slots], live)
+        hit, rows, _, _ = ring.take(live)
+        assert hit.all()
+        np.testing.assert_array_equal(rows, src[live])
+
+    def test_stage_filters_already_staged_ids(self):
+        ring = StagingRing(8, 2, np.float32, 32)
+        rows = np.arange(8, dtype=np.float32).reshape(4, 2)
+        assert ring.stage(np.array([1, 2, 3, 4]), rows) == 4
+        # restage an overlapping set: only the new id lands
+        assert ring.stage(np.array([2, 3, 9, 4]),
+                          np.zeros((4, 2), np.float32)) == 1
+        hit, got, _, _ = ring.take(np.array([2, 3]))
+        assert hit.all()
+        np.testing.assert_array_equal(got, rows[1:3])   # NOT zeroed
+
+
+def make_store(kwargs, **prefetch_kwargs):
+    from quiver_tpu.ops import quant
+    tier = quant.QuantizedTensor(
+        np.load(kwargs["path"], mmap_mode="r"),
+        np.load(kwargs["scale"]), np.load(kwargs["zero"]))
+    ref = np.asarray(quant.take_np(tier, np.arange(N)))
+    f = qv.Feature()
+    f.from_mmap(None, qv.DeviceConfig([ref[:CACHE]], None))
+    f.set_mmap_file(**kwargs)
+    if prefetch_kwargs:
+        f.enable_cold_prefetch(**prefetch_kwargs)
+    return f
+
+
+class TestParallelStagingStore:
+    @pytest.mark.parametrize("decode_staged", [True, False])
+    def test_workers_bit_identical_on_off(self, artifact, rng,
+                                          decode_staged):
+        _, kwargs, _, _ = artifact
+        off = make_store(kwargs)
+        on = make_store(kwargs, capacity_rows=256, workers=3, io_qd=4,
+                        io_cap_bytes=256, decode_staged=decode_staged)
+        assert on._cold_prefetch.workers == 3
+        for _ in range(3):
+            pool = rng.integers(0, N, 64)
+            ids = pool[rng.integers(0, pool.size, 128)].astype(np.int64)
+            ids[rng.random(128) < 0.25] = -1
+            on.stage_frontier(ids).result()
+            np.testing.assert_array_equal(
+                np.asarray(off[jnp.asarray(np.abs(ids))]),
+                np.asarray(on[jnp.asarray(np.abs(ids))]))
+            np.testing.assert_array_equal(
+                np.asarray(off.getitem_masked(jnp.asarray(ids))),
+                np.asarray(on.getitem_masked(jnp.asarray(ids))))
+        st = on._cold_prefetch.stats()
+        assert st["io"]["extents"] > 0 and st["staged_rows"] > 0
+        off.close()
+        on.close()
+
+    def test_close_reaps_stager_threads(self, artifact):
+        _, kwargs, _, _ = artifact
+        f = make_store(kwargs, capacity_rows=128, workers=2)
+        f.stage_frontier(np.arange(CACHE, CACHE + 64)).result()
+        f.close()
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith(("qt-stager", "qt-io-reader"))
+                    and t.is_alive()]
+
+    def test_truncated_stat_counts_and_logs_once(self, artifact,
+                                                 caplog):
+        _, kwargs, _, _ = artifact
+        f = make_store(kwargs, capacity_rows=16, workers=2)
+        pf = f._cold_prefetch
+        wide = np.arange(CACHE, N)             # >> 16-slot ring
+        with caplog.at_level(logging.WARNING, "quiver_tpu.prefetch"):
+            pf.publish(wide, block=True).result()
+            pf.publish(wide, block=True).result()
+        msgs = [r for r in caplog.records
+                if "wider than the staging ring" in r.message]
+        assert len(msgs) == 1                  # logged ONCE
+        st = pf.stats()
+        assert st["truncated_rows"] > 0
+        # observe_into surfaces the truncation delta as a hub series
+        class Hub:
+            seen = {}
+
+            def observe(self, name, value):
+                self.seen[name] = value
+
+        d = pf.observe_into(Hub())
+        assert d["truncated_rows"] == st["truncated_rows"]
+        assert Hub.seen.get("prefetch_truncated_rows") == \
+            d["truncated_rows"]
+        f.close()
+
+    def test_io_slots_flow_through_metered_lookup(self, artifact, rng):
+        _, kwargs, _, _ = artifact
+        f = make_store(kwargs, capacity_rows=256, workers=2, io_qd=4)
+        cold = rng.choice(np.arange(CACHE, N), 64, replace=False)
+        f.stage_frontier(cold).result()
+        _, vec = f.lookup_tiered(cold, collect_metrics=True)
+        assert vec[qm.IO_EXTENTS] > 0
+        assert vec[qm.IO_READ_ROWS] >= vec[qm.IO_EXTENTS]
+        assert vec[qm.IO_READ_BYTES] > 0
+        assert 1 <= vec[qm.IO_DEPTH_PEAK] <= 4
+        d = qm.derive(vec)
+        assert d["io_coalescing_factor"] == pytest.approx(
+            vec[qm.IO_READ_ROWS] / vec[qm.IO_EXTENTS])
+        # drained: a second metered lookup attributes nothing new
+        _, vec2 = f.lookup_tiered(cold, collect_metrics=True)
+        assert vec2[qm.IO_EXTENTS] == 0
+        assert qm.IO_DEPTH_PEAK in qm.MAX_SLOTS
+        f.close()
+
+    def test_qd_staging_rate_pin(self, artifact):
+        """The acceptance pin at test scale: the SAME publication
+        staged through the QD1 mmap path vs the deep-queue parallel
+        path under the deterministic model — >= 3x staged-rows/s
+        (bench_feature.py --ab-prefetch carries it at full scale)."""
+        _, kwargs, _, _ = artifact
+        ids = np.arange(CACHE, CACHE + 256)
+
+        def rate(**pf_kwargs):
+            f = make_store(kwargs, capacity_rows=512, **pf_kwargs)
+            pf = f._cold_prefetch
+            t0 = time.perf_counter()
+            pf.publish(ids, block=True).result()
+            dt = time.perf_counter() - t0
+            staged = pf.stats()["staged_rows"]
+            f.close()
+            return staged / dt
+
+        service = 200.0                  # us; QD1 pays 256 x 200us
+        # QD1 arm: per-row serial model charges through a wrapped mmap
+        f1 = make_store(kwargs, capacity_rows=512, workers=1,
+                        io_engine="mmap")
+        m1 = StorageModel(service, qd=16)
+
+        class SerialModelMmap:
+            def __init__(self, mm, model):
+                self._mm, self._model = mm, model
+
+            def __getitem__(self, rows):
+                r = np.asarray(rows)
+                if r.ndim:
+                    self._model.request(n=int(np.unique(r).size))
+                return self._mm[rows]
+
+            def __getattr__(self, name):
+                return getattr(self._mm, name)
+
+        f1.mmap_array = SerialModelMmap(f1.mmap_array, m1)
+        pf1 = f1._cold_prefetch
+        t0 = time.perf_counter()
+        pf1.publish(ids, block=True).result()
+        qd1_rate = pf1.stats()["staged_rows"] / (time.perf_counter()
+                                                 - t0)
+        f1.close()
+        qdn_rate = rate(workers=2, io_qd=16,
+                        io_model=StorageModel(service, qd=16))
+        assert qdn_rate >= 3 * qd1_rate, \
+            f"QD16 staging {qdn_rate:.0f} rows/s < 3x QD1 " \
+            f"{qd1_rate:.0f} rows/s"
+
+
+class TestIoWorkersAdvice:
+    def _hub(self, hit, thr_points, io_workers=2, io_qd=16):
+        from quiver_tpu.telemetry import PlanContext, TelemetryHub
+        hub = TelemetryHub(window=4, watches=())
+        hub.plan = PlanContext(io_workers=io_workers, io_qd=io_qd)
+        for v in thr_points:
+            hub.observe("cold_staged_rows_per_s", v)
+            hub.observe("prefetch_hit_rate", hit)
+        return hub
+
+    def test_flat_curve_with_sync_fallbacks_advises_doubling(self):
+        hub = self._hub(0.55, [1000.0, 1010.0, 995.0, 1005.0])
+        recs = {r["key"]: r for r in hub.replan()}
+        assert "io_workers" in recs
+        rec = recs["io_workers"]
+        assert rec["current"] == 2 and rec["recommended"] == 4
+        assert "io_workers" in hub.advice
+
+    def test_respects_the_io_qd_ceiling(self):
+        hub = self._hub(0.55, [1000.0] * 4, io_workers=8, io_qd=8)
+        assert not [r for r in hub.replan()
+                    if r["key"] == "io_workers"]
+
+    def test_healthy_hit_rate_advises_nothing(self):
+        hub = self._hub(0.97, [1000.0] * 4)
+        assert not [r for r in hub.replan()
+                    if r["key"] == "io_workers"]
+
+    def test_rising_curve_advises_nothing(self):
+        # throughput still climbing: current width is delivering
+        hub = self._hub(0.55, [500.0, 800.0, 1200.0, 1800.0])
+        assert not [r for r in hub.replan()
+                    if r["key"] == "io_workers"]
+
+    def test_advice_key_documented(self):
+        from quiver_tpu.telemetry import ADVICE_KEYS
+        assert "io_workers" in ADVICE_KEYS
+
+
+class TestHostLintSeesReader:
+    def test_reader_resource_requires_close(self):
+        from quiver_tpu.analysis.host_lint import check_source
+        src = ("class Holder:\n"
+               "    def __init__(self, mm):\n"
+               "        self._r = ExtentReader(mm, 'f', (1, 1), 0)\n")
+        bad = check_source(src, "x.py")
+        assert any(f.rule == "resource_finalizer" for f in bad)
+        ok = check_source(src + "    def close(self):\n"
+                                "        self._r.close()\n", "x.py")
+        assert not ok
+
+
+class TestMetricsSurface:
+    def test_io_slot_names_registered(self):
+        assert qm.SLOT_NAMES[qm.IO_EXTENTS] == "io_extents"
+        assert qm.SLOT_NAMES[qm.IO_READ_ROWS] == "io_read_rows"
+        assert qm.SLOT_NAMES[qm.IO_READ_BYTES] == "io_read_bytes"
+        assert qm.SLOT_NAMES[qm.IO_DEPTH_PEAK] == "io_depth_peak"
+        assert max(qm.SLOT_NAMES) < qm.NUM_COUNTERS
+
+    def test_report_includes_io_line_when_active(self):
+        stats = qm.StepStats()
+        vec = np.zeros(qm.NUM_COUNTERS, np.int32)
+        vec[qm.IO_EXTENTS] = 10
+        vec[qm.IO_READ_ROWS] = 80
+        vec[qm.IO_READ_BYTES] = 4_000_000
+        vec[qm.IO_DEPTH_PEAK] = 16
+        stats.add_counters(vec)
+        rep = stats.report()
+        assert "cold-tier IO: 10 extents" in rep
+        assert "8.00 rows/extent" in rep
+        assert "depth peak 16" in rep
+        assert "cold-tier IO" not in qm.StepStats().report()

@@ -525,21 +525,32 @@ class TestPrefetchObserveInto:
         pf = qv.ColdPrefetcher.__new__(qv.ColdPrefetcher)
         pf._counters = np.array([30, 10, 100], np.int64)
         pf._published, pf._dropped = 4, 1
-        pf._hub_last = np.zeros(5, np.int64)
+        pf._truncated = 0
+        pf._hub_last = np.zeros(6, np.int64)
+        pf._hub_t = None
         pf._lock = threading.Lock()
         hub = qt.TelemetryHub(watches=())
         d = pf.observe_into(hub)
         assert d == {"hit_rows": 30, "sync_rows": 10,
-                     "staged_rows": 100, "published": 4, "dropped": 1}
+                     "staged_rows": 100, "published": 4, "dropped": 1,
+                     "truncated_rows": 0}
         assert hub.series["prefetch_hit_rate"].last() == \
             pytest.approx(0.75)
         assert hub.series["prefetch_drop_rate"].last() == \
             pytest.approx(0.25)
+        # the first call armed the interval clock: no rows/s point yet
+        assert "cold_staged_rows_per_s" not in hub.series
         pf._counters = np.array([40, 40, 150], np.int64)
+        pf._truncated = 7
         d = pf.observe_into(hub)                   # the DELTA, not the
         assert d["hit_rows"] == 10                 # lifetime total
+        assert d["truncated_rows"] == 7
+        assert d["staged_rows_per_s"] > 0          # 50 rows / interval
         assert hub.series["prefetch_hit_rate"].last() == \
             pytest.approx(10 / 40)
+        assert hub.series["cold_staged_rows_per_s"].last() == \
+            pytest.approx(d["staged_rows_per_s"])
+        assert hub.series["prefetch_truncated_rows"].last() == 7
 
 
 class TestFlightRecorder:
