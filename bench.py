@@ -310,6 +310,12 @@ def main():
         return run_epoch
 
     exact_rows = {}
+    # per-batch wall of the newest FULL sampling epoch; the headline
+    # selection code below copies it into the sample-stage row at the
+    # points where a run actually BECOMES the headline, so the
+    # stage_ms block always attributes the arm of record (a losing
+    # full-epoch window probe must not leave its wall behind)
+    _full_epoch = {}
 
     def measure(n_batches, method, layout, salt, shuffle):
         run = make_epoch(n_batches, method, layout, shuffle)
@@ -337,6 +343,8 @@ def main():
                        args={"method": method, "layout": layout,
                              "shuffle": shuffle, "batches": n_batches,
                              "edges": total_edges})
+        if n_batches == batches:
+            _full_epoch["ms_per_batch"] = dt / n_batches * 1e3
         return total_edges / dt
 
     # metric of record: rotation mode, full epoch (accuracy parity with
@@ -362,6 +370,9 @@ def main():
     # config. Cheap — the winner is already compiled.
     seps = (measure(batches, "rotation", layout, 50, shuffle=shuffle)
             if len(by_cfg) > 1 else _sel)
+    # the headline's sample wall: either the re-measurement just taken
+    # or (single-candidate sweep) the sweep's own full-epoch run
+    sample_ms_per_batch = _full_epoch.get("ms_per_batch", 0.0)
     rotation_seps = seps          # the rotation row of the per-mode block
     # secondary figures on a shorter epoch slice (clamped to the seeds
     # the node count can supply): exact i.i.d. mode, and window mode
@@ -390,6 +401,7 @@ def main():
             # the reported headline
             mode = "window"
             seps = measure(batches, "window", layout, 61, shuffle=shuffle)
+            sample_ms_per_batch = _full_epoch.get("ms_per_batch", 0.0)
 
     # ---- feature-gather figure: the BANDWIDTH half of the paper ----
     # (SEPS tracks sampling latency; this tracks tiered feature
@@ -495,14 +507,26 @@ def main():
         plan_facts = {"hot_capacity": int(store.cache_rows),
                       "total_rows": f_rows,
                       "dedup_budget": dedup_budget}
+        # modeled bytes the timed loop moved per batch — the roofline
+        # numerator for the gather stage: output rows written + hot
+        # rows read + (dedup'd) cold-tier bytes + the frontier-id
+        # index buffer. Divided by the timed wall and the machine
+        # probe's random-gather peak, this is gather_efficiency —
+        # "how far from this box's limits the tiered gather runs"
+        hot_rows_pb = counts[qmetrics.HOT_ROWS] / len(batches_f)
+        gather_bytes_pb = (f_batch * f_dim * 4           # output write
+                           + hot_rows_pb * f_dim * 4     # hot reads
+                           + host_bytes / len(batches_f)  # cold reads
+                           + f_batch * 4)                # frontier ids
+        gather_ms_pb = dt / len(batches_f) * 1e3
         return (rps, host_bytes / len(batches_f), exch_bytes, cap,
                 compact_bytes, observed, observed_cold_rows,
-                counter_vecs, plan_facts)
+                counter_vecs, plan_facts, gather_bytes_pb, gather_ms_pb)
 
     (feature_gather_rps, host_bytes_per_batch, exchange_bytes_per_batch,
      exchange_cap, exchange_compact_bytes_per_batch, observed,
-     observed_cold_rows, counter_vecs, plan_facts) = \
-        measure_feature_gather()
+     observed_cold_rows, counter_vecs, plan_facts, gather_bytes_pb,
+     gather_ms_per_batch) = measure_feature_gather()
 
     # ---- cold-tier (disk mmap) figure: the THIRD rung of the
     # hierarchy. A small quantized disk-tier artifact (int8 rows +
@@ -576,13 +600,44 @@ def main():
             # batch, so the per-batch figure is the timed delta over
             # the batches that PUBLISHED during the loop
             return (cold_slots / dt, hit_rate,
-                    staged / max(n_batches_c - 1, 1), staged / dt)
+                    staged / max(n_batches_c - 1, 1), staged / dt,
+                    dt / n_batches_c * 1e3)
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
 
     (cold_rows_per_s, prefetch_hit_rate,
      prefetch_staged_rows_per_batch,
-     cold_staged_rows_per_s) = measure_cold_tier()
+     cold_staged_rows_per_s, cold_ms_per_batch) = measure_cold_tier()
+
+    # ---- qt-prof figures: machine probe + per-stage attribution ----
+    # one small probe of what THIS box delivers (quiver_tpu.profile
+    # machine_probe — memcpy / random-gather / h2d GB/s), then the
+    # gather stage's roofline efficiency = modeled bytes over the
+    # timed wall over the probed random-gather peak. The stage_ms /
+    # stage_shares block is the coarse per-stage attribution of one
+    # bench pass (each stage's per-batch wall at its own bench scale)
+    # — the trend bench_regress tracks; scripts/qt_prof.py carries the
+    # fine-grained per-entry attribution.
+    gather_efficiency = None
+    gather_achieved_gbps = None
+    probe_gather_gbps = None
+    try:
+        from quiver_tpu.profile import machine_probe
+        probe = machine_probe(quick=True)
+        probe_gather_gbps = probe["gather_gbps"]
+        gather_achieved_gbps = (gather_bytes_pb
+                                / (gather_ms_per_batch / 1e3) / 1e9)
+        gather_efficiency = gather_achieved_gbps / probe_gather_gbps
+    except Exception as e:          # the probe must never fail a run
+        print(f"machine probe failed: {e!r}", file=sys.stderr)
+    stage_ms = {
+        "sample": round(sample_ms_per_batch, 3),
+        "gather": round(gather_ms_per_batch, 3),
+        "cold_tier": round(cold_ms_per_batch, 3),
+    }
+    stage_total = sum(stage_ms.values())
+    stage_shares = {k: round(v / stage_total, 4) if stage_total else None
+                    for k, v in stage_ms.items()}
     out = {
         "metric": METRIC,
         "value": round(seps, 1),
@@ -637,6 +692,18 @@ def main():
         # bench_regress trajectory group from this round on, so a
         # QD/coalescing regression fails the sweep loudly
         "cold_staged_rows_per_s": round(cold_staged_rows_per_s, 1),
+        # qt-prof: roofline efficiency of the tiered gather (modeled
+        # bytes / timed wall / probed random-gather peak — its own
+        # bench_regress trajectory group from this round) + the
+        # coarse per-stage attribution of this bench pass
+        "gather_efficiency": (round(gather_efficiency, 4)
+                              if gather_efficiency is not None else None),
+        "gather_achieved_gbps": (round(gather_achieved_gbps, 3)
+                                 if gather_achieved_gbps is not None
+                                 else None),
+        "probe_gather_gbps": probe_gather_gbps,
+        "stage_ms": stage_ms,
+        "stage_shares": stage_shares,
     }
     # every measured rotation config, for the record (always present so
     # log consumers never hit a missing key)

@@ -7,8 +7,8 @@
 # evidence pipeline commits it with -f).
 #
 # Usage: sh benchmarks/chip_suite.sh [section ...]
-#   sections: verify bench dispatch sampler gather tiered offload io
-#             e2e exchange mixed hetero micro ablate regress
+#   sections: verify prof bench dispatch sampler gather tiered offload
+#             io e2e exchange mixed hetero micro ablate regress
 #   default       = every section
 #   quick         = bench only (the metric of record; also warms the
 #                   compile cache for a later full sweep)
@@ -24,7 +24,7 @@ export QT_METRICS_JSONL
 SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-verify bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
+SECTIONS="${*:-verify prof bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -45,6 +45,15 @@ fi
 # history, so qt_top shows them red in the same view
 if want verify; then
     step env JAX_PLATFORMS=cpu python -u scripts/qt_verify.py --jsonl "$QT_METRICS_JSONL"
+fi
+
+# per-stage attribution + roofline efficiency (qt-prof): best-of-N
+# timing of every registered entry + lattice point against the
+# analytic cost model and this box's probed peaks — CPU-only like
+# verify (never claims the chip); profile records land beside the
+# bench history so qt_top shows the stage panel in the same view
+if want prof; then
+    step env JAX_PLATFORMS=cpu python -u scripts/qt_prof.py --quick --jsonl "$QT_METRICS_JSONL"
 fi
 
 # metric of record: the full default sweep (pair/sort, overlap/sort,

@@ -55,8 +55,9 @@ from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
                       ServeEngine, build_serve_step)
 from .telemetry import FlightRecorder, PlanContext, TelemetryHub
+from .profile import StageProfiler, machine_probe
 from . import (analysis, comm, profiling, checkpoint, datasets, debug,
-               metrics, serving, telemetry, tracing)
+               metrics, profile, serving, telemetry, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -127,4 +128,6 @@ __all__ = [
     "TelemetryHub",
     "PlanContext",
     "FlightRecorder",
+    "StageProfiler",
+    "machine_probe",
 ]

@@ -17,6 +17,7 @@ docs/analysis.md for the rule table and the ``lint`` JSONL schema.
 """
 
 from . import host_lint  # noqa: F401  (stdlib-only half)
+from .costmodel import CostModel, cost_of, cost_of_fn  # noqa: F401
 from .findings import ERROR, INFO, WARN, Finding, has_errors, \
     sort_findings  # noqa: F401
 from .jaxpr_lint import (CensusSpec, EntrySpec, RULES,  # noqa: F401

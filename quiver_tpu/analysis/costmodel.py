@@ -1,0 +1,309 @@
+"""Analytic cost model on the shared ``make_jaxpr`` trace — qt-prof's
+"modeled" half.
+
+``jaxpr_lint`` checks the traced program against *budgets* (may this
+gather read more than N rows?); this module prices the same trace in
+absolute units so the profiler (``quiver_tpu.profile``) can divide
+modeled bytes by measured time and compare against the machine probe's
+peaks — roofline efficiency per stage, no chip-time experiment needed.
+
+One walk of the one shared trace per entry point (the same trace
+``qt_verify``'s rules already take — no second ``make_jaxpr``) yields:
+
+- **FLOPs** from the ``dot_general`` family (2 * out-elements * K per
+  contraction — the model/apply cost);
+- **gather bytes**: bytes every ``gather`` equation reads from its
+  operand (the tiered-lookup and frontier-gather traffic), plus the
+  bytes of the *index* operands feeding those gathers —
+  ``gather_index_bytes``, the frontier-id round trip a fused
+  sample+gather kernel (ROADMAP frontier 2) deletes. That number IS
+  the fusion-headroom baseline: the intermediate buffer between sample
+  and gather that never needs to touch HBM once the kernel lands.
+- **collective bytes** (``all_to_all``/``all_gather``/... payloads —
+  the exchange's wire cost, via the same accounting as
+  ``collective_payloads``);
+- **input/output bytes**: full reads of every entry argument *not*
+  consumed through a gather (model params, CSR arrays a kernel scans)
+  and the program's output writes;
+- **per-tier bytes** for each tier the entry declares
+  (``EntrySpec.tier_budgets``), via the shared ``gather_reads`` walker.
+
+Control flow is priced honestly rather than optimistically:
+``lax.scan`` bodies multiply by their trip count, ``lax.while`` bodies
+count once and increment ``while_loops`` (unknown trip count — the
+model is a floor there), and ``lax.cond`` contributes the elementwise
+MINIMUM over its branches (a cond executes exactly one branch, so the
+min is a true lower bound; the spread to the heaviest branch is
+recorded as ``cond_extra_bytes`` so a narrow/fallback exchange still
+shows its worst case). Efficiency computed from these bytes is
+therefore conservative: the real program moves at least this much.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+import numpy as np
+
+import jax
+
+from .jaxpr_lint import (COLLECTIVE_PRIMS, EntrySpec, _Literal,
+                         _as_jaxpr, _tier_specs, gather_reads)
+
+#: cost fields the branch-min/branch-max fold runs over
+_FIELDS = ("flops", "gather_bytes", "gather_index_bytes",
+           "collective_bytes")
+
+
+def _nbytes(aval) -> int:
+    return int(np.prod(aval.shape)) * aval.dtype.itemsize
+
+
+def _zero() -> Dict[str, float]:
+    return {k: 0 for k in _FIELDS}
+
+
+def _acc(a: Dict[str, float], b: Dict[str, float]) -> None:
+    for k in _FIELDS:
+        a[k] += b[k]
+
+
+@dataclass
+class CostModel:
+    """The priced trace of one entry point / stage.
+
+    All byte fields are LOWER bounds (cond -> min branch, while -> one
+    trip); ``cond_extra_bytes`` carries the spread to the heaviest
+    branch and ``while_loops`` the number of unknown-trip loops the
+    floor ignores."""
+
+    flops: int = 0
+    gather_bytes: int = 0
+    gather_index_bytes: int = 0   # the fusion-headroom baseline
+    collective_bytes: int = 0
+    input_bytes: int = 0          # non-gathered args, read in full
+    output_bytes: int = 0
+    tier_bytes: Dict[str, int] = field(default_factory=dict)
+    cond_extra_bytes: int = 0
+    while_loops: int = 0
+
+    @property
+    def total_bytes(self) -> int:
+        """The roofline numerator: bytes the program must move at
+        minimum — gathered rows + their index buffers + collective
+        payloads + full reads of non-gathered inputs + output
+        writes."""
+        return int(self.gather_bytes + self.gather_index_bytes
+                   + self.collective_bytes + self.input_bytes
+                   + self.output_bytes)
+
+    def record(self) -> dict:
+        """JSONL-ready payload (the ``modeled`` block of a ``profile``
+        record)."""
+        rec = {
+            "flops": int(self.flops),
+            "gather_bytes": int(self.gather_bytes),
+            "gather_index_bytes": int(self.gather_index_bytes),
+            "collective_bytes": int(self.collective_bytes),
+            "input_bytes": int(self.input_bytes),
+            "output_bytes": int(self.output_bytes),
+            "total_bytes": self.total_bytes,
+        }
+        if self.cond_extra_bytes:
+            rec["cond_extra_bytes"] = int(self.cond_extra_bytes)
+        if self.while_loops:
+            rec["while_loops"] = int(self.while_loops)
+        if self.tier_bytes:
+            rec["tier_bytes"] = dict(self.tier_bytes)
+        return rec
+
+
+def _dot_flops(eqn) -> int:
+    """2 * out-elements * K for one ``dot_general`` (K = contracted
+    extent of the lhs)."""
+    (lhs_c, _), _ = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval
+    k = 1
+    for d in lhs_c:
+        k *= int(lhs.shape[d])
+    out = int(np.prod(eqn.outvars[0].aval.shape))
+    return 2 * out * k
+
+
+class _CostWalk:
+    """One recursive pricing pass; gather-operand vars and index vars
+    are tracked across the whole walk and resolved through reshape/
+    broadcast/convert chains AND inner-jaxpr boundaries (pjit,
+    shard_map, cond branches) back to their origin, so one frontier-id
+    buffer feeding two tier gathers counts once and a gathered entry
+    argument is never ALSO priced as a full input read."""
+
+    def __init__(self):
+        self.gather_operands: set = set()   # origin ids gathers read
+        self.index_origins: set = set()     # origin ids of index bufs
+        self.extra_bytes = 0
+        self.while_loops = 0
+        self._alias: Dict[int, int] = {}    # var id -> parent var id
+
+    def _origin(self, var) -> int:
+        vid = id(var)
+        seen = set()
+        while vid in self._alias and vid not in seen:
+            seen.add(vid)
+            vid = self._alias[vid]
+        return vid
+
+    def _bind(self, inner, outer_invars) -> None:
+        """Alias an inner jaxpr's invars to the outer equation's
+        operands (1:1 positional — pjit/closed-call/shard_map/cond
+        branches all satisfy this)."""
+        inner_vars = _as_jaxpr(inner).invars
+        if len(inner_vars) != len(outer_invars):
+            return
+        for iv, ov in zip(inner_vars, outer_invars):
+            if not isinstance(ov, _Literal):
+                self._alias[id(iv)] = id(ov)
+
+    def walk(self, jaxpr, mult: int = 1) -> Dict[str, float]:
+        jxp = _as_jaxpr(jaxpr)
+        cost = _zero()
+        for eqn in jxp.eqns:
+            name = eqn.primitive.name
+
+            if name == "dot_general":
+                cost["flops"] += mult * _dot_flops(eqn)
+
+            elif name == "gather":
+                op, idx = eqn.invars[0], eqn.invars[1]
+                cost["gather_bytes"] += mult * _nbytes(eqn.outvars[0].aval)
+                self.gather_operands.add(self._origin(op))
+                if not isinstance(idx, _Literal):
+                    # index bytes accrue into the BRANCH-SCOPED cost
+                    # (so the cond min/max fold applies — an index
+                    # buffer only the fallback branch reads must not
+                    # leak into the floor), deduped by origin so one
+                    # frontier-id buffer feeding two gathers counts
+                    # once
+                    oid = self._origin(idx)
+                    if oid not in self.index_origins:
+                        self.index_origins.add(oid)
+                        cost["gather_index_bytes"] += \
+                            mult * _nbytes(idx.aval)
+
+            elif name in COLLECTIVE_PRIMS:
+                cost["collective_bytes"] += mult * _nbytes(
+                    eqn.invars[0].aval)
+
+            if name == "cond":
+                branches = []
+                for br in eqn.params["branches"]:
+                    self._bind(br, eqn.invars[1:])
+                    branches.append(self.walk(br, mult))
+                low = {k: min(b[k] for b in branches) for k in _FIELDS}
+                high = {k: max(b[k] for b in branches) for k in _FIELDS}
+                _acc(cost, low)
+                self.extra_bytes += sum(
+                    int(high[k] - low[k]) for k in _FIELDS
+                    if k != "flops")
+            elif name == "scan":
+                length = int(eqn.params.get("length", 1))
+                # body invars are consts + carry + per-iteration xs
+                # slices, positionally 1:1 with the eqn operands —
+                # bind them so a table gathered inside the loop is not
+                # ALSO priced as a full input read
+                self._bind(eqn.params["jaxpr"], eqn.invars)
+                _acc(cost, self.walk(eqn.params["jaxpr"], mult * length))
+            elif name == "while":
+                self.while_loops += 1
+                cc = int(eqn.params.get("cond_nconsts", 0))
+                bc = int(eqn.params.get("body_nconsts", 0))
+                carry = list(eqn.invars[cc + bc:])
+                self._bind(eqn.params["body_jaxpr"],
+                           list(eqn.invars[cc:cc + bc]) + carry)
+                self._bind(eqn.params["cond_jaxpr"],
+                           list(eqn.invars[:cc]) + carry)
+                _acc(cost, self.walk(eqn.params["body_jaxpr"], mult))
+                _acc(cost, self.walk(eqn.params["cond_jaxpr"], mult))
+            elif name == "shard_map":
+                # the body jaxpr is per-shard work; every shard of the
+                # mesh runs it, and on the virtual CPU mesh (and any
+                # single-host roofline) all of it moves through this
+                # box's memory system
+                mesh = eqn.params.get("mesh")
+                n = int(getattr(mesh, "size", 1) or 1)
+                self._bind(eqn.params["jaxpr"], eqn.invars)
+                _acc(cost, self.walk(eqn.params["jaxpr"], mult * n))
+            else:
+                recursed = False
+                for k in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                    sub = eqn.params.get(k)
+                    if sub is not None and (hasattr(sub, "jaxpr")
+                                            or hasattr(sub, "eqns")):
+                        self._bind(sub, eqn.invars)
+                        _acc(cost, self.walk(sub, mult))
+                        recursed = True
+                        break
+                if not recursed and len(eqn.outvars) == 1:
+                    # dataflow aliasing: an op whose every (non-literal)
+                    # input resolves to ONE origin buffer yields a view/
+                    # derivation of that buffer (reshape, broadcast,
+                    # convert, the negative-index wrap's lt/add/select
+                    # chain) — its output is still the same logical
+                    # buffer for index/operand dedup purposes
+                    origins = {self._origin(v) for v in eqn.invars
+                               if not isinstance(v, _Literal)}
+                    if len(origins) == 1:
+                        self._alias[id(eqn.outvars[0])] = origins.pop()
+        return cost
+
+
+def cost_of_jaxpr(jaxpr, tiers: Tuple = ()) -> CostModel:
+    """Price an already-traced (closed) jaxpr. ``tiers`` is an optional
+    sequence of tier pytrees (``EntrySpec.tier_budgets`` storage
+    arrays) to break gather traffic out per tier."""
+    jxp = _as_jaxpr(jaxpr)
+    w = _CostWalk()
+    cost = w.walk(jxp)
+    model = CostModel(
+        flops=int(cost["flops"]),
+        gather_bytes=int(cost["gather_bytes"]),
+        gather_index_bytes=int(cost["gather_index_bytes"]),
+        collective_bytes=int(cost["collective_bytes"]),
+        cond_extra_bytes=int(w.extra_bytes),
+        while_loops=w.while_loops,
+    )
+    # args never consumed through a gather are modeled as read in full
+    # (model params, the CSR arrays sampling scans); gathered operands
+    # are priced by their gathers and index args by gather_index_bytes
+    # (origin resolution makes this hold across pjit boundaries and
+    # reshape/convert chains)
+    model.input_bytes = int(sum(
+        _nbytes(v.aval) for v in jxp.invars
+        if id(v) not in w.gather_operands
+        and id(v) not in w.index_origins))
+    out_avals = (jaxpr.out_avals if hasattr(jaxpr, "out_avals")
+                 else [v.aval for v in jxp.outvars])
+    model.output_bytes = int(sum(_nbytes(a) for a in out_avals))
+    for tier in tiers:
+        for shape, dt in _tier_specs(tier):
+            width = int(np.prod(shape[1:])) * dt.itemsize
+            rows = sum(r for r, d in gather_reads(jaxpr, shape, dt)
+                       if d == 0)
+            key = f"{tuple(shape)}:{dt}"
+            model.tier_bytes[key] = (model.tier_bytes.get(key, 0)
+                                     + rows * width)
+    return model
+
+
+def cost_of(spec: EntrySpec) -> CostModel:
+    """Price one registered entry point on its one shared trace (the
+    same cached ``spec.jaxpr()`` the verifier rules walk)."""
+    return cost_of_jaxpr(spec.jaxpr(),
+                         tiers=tuple(t for t, _, _ in spec.tier_budgets))
+
+
+def cost_of_fn(fn, args) -> CostModel:
+    """Price an arbitrary traceable callable (used by the profiler's
+    pipeline stages, which are not registry entries)."""
+    return cost_of_jaxpr(jax.make_jaxpr(fn)(*args))

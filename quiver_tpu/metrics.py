@@ -44,10 +44,13 @@ block with per-REQUEST admission->result latency percentiles and a
 ``scope_timer`` (``profiling.ScopeTimer.emit`` — accumulated wall-clock
 stage timings), ``anomaly`` / ``advice``
 (``telemetry.TelemetryHub`` — change-point detections and advisory
-re-planning records), and ``regress`` (``scripts/bench_regress.py`` —
-per-trajectory-group verdicts). Consumers key on ``kind`` and must
-ignore unknown fields; ``scripts/lint.sh`` pins that every kind and
-every counter slot has a row in docs/observability.md.
+re-planning records), ``regress`` (``scripts/bench_regress.py`` —
+per-trajectory-group verdicts), and ``profile``
+(``quiver_tpu.profile.StageProfiler`` / ``scripts/qt_prof.py`` —
+per-entry stage timings, modeled bytes, roofline efficiency).
+Consumers key on ``kind`` and must ignore unknown fields;
+``scripts/lint.sh`` pins that every kind and every counter slot has a
+row in docs/observability.md.
 """
 
 from __future__ import annotations

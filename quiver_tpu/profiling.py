@@ -97,8 +97,11 @@ class ScopeTimer:
             tracing.record(f"scope.{name}", t0, dt)
 
     def mean(self, name: str) -> float:
+        # .get on BOTH maps: indexing the defaultdicts here would
+        # insert a phantom 0.0/0 row for a never-measured name, which
+        # summary()/summary_dict() would then report as a real scope
         c = self.counts.get(name, 0)
-        return self.totals[name] / c if c else 0.0
+        return self.totals.get(name, 0.0) / c if c else 0.0
 
     def summary(self) -> str:
         lines = [f"{k}: {self.totals[k]:.4f}s total, "

@@ -283,6 +283,11 @@ DEFAULT_WATCHES = (
     ("dup_factor", "page_hinkley", {"delta": 0.05, "threshold": 1.0}),
     ("prefetch_hit_rate", "mean_shift", {"direction": "down"}),
     ("recompiles", "spike", {}),
+    # a stage silently growing its share of the step (the profiler's
+    # stage_share:<entry>/<stage> series — a trailing * is a PREFIX
+    # watch, armed lazily on every matching series as it appears)
+    ("stage_share:*", "mean_shift", {"direction": "up",
+                                     "min_abs": 0.05}),
 )
 
 
@@ -396,6 +401,7 @@ class TelemetryHub:
         self.plan = plan
         self.series: Dict[str, SeriesRing] = {}
         self._detectors: Dict[str, List] = {}
+        self._prefix_watches: List[tuple] = []
         self._pending: List = []
         self._counters = np.zeros((_metrics.NUM_COUNTERS,), np.int64)
         self._steps = 0
@@ -421,23 +427,47 @@ class TelemetryHub:
         s = self.series.get(name)
         if s is None:
             s = self.series[name] = SeriesRing(self.capacity)
+            # prefix watches arm lazily: series names under a watched
+            # prefix (e.g. the profiler's stage_share:<entry>/<stage>)
+            # are not enumerable up front, so each new matching series
+            # gets its own detector instance the moment it appears
+            for prefix, cls, params in self._prefix_watches:
+                if name.startswith(prefix):
+                    self._detectors.setdefault(name, []).append(
+                        cls(**self._detector_params(cls, params)))
         return s
+
+    def _detector_params(self, cls, params: dict) -> dict:
+        p = dict(params)
+        if cls is MeanShiftDetector:
+            p.setdefault("window", self.window)
+        return p
 
     def watch(self, name: str, detector: str = "mean_shift",
               **params) -> "TelemetryHub":
         """Arm a change-point ``detector`` (one of
         ``DETECTOR_NAMES``) on series ``name``. Detectors default to
-        the hub's ``window`` where they take one."""
+        the hub's ``window`` where they take one. A ``name`` ending in
+        ``*`` is a PREFIX watch: every series whose name starts with
+        the prefix gets its own detector instance when it first
+        appears (existing matching series are armed immediately)."""
         try:
             cls = _DETECTOR_TYPES[detector]
         except KeyError:
             raise ValueError(
                 f"unknown detector {detector!r}; "
                 f"one of {DETECTOR_NAMES}") from None
-        if cls is MeanShiftDetector:
-            params.setdefault("window", self.window)
         with self._lock:
-            self._detectors.setdefault(name, []).append(cls(**params))
+            if name.endswith("*"):
+                prefix = name[:-1]
+                self._prefix_watches.append((prefix, cls, params))
+                for existing in self.series:
+                    if existing.startswith(prefix):
+                        self._detectors.setdefault(existing, []).append(
+                            cls(**self._detector_params(cls, params)))
+            else:
+                self._detectors.setdefault(name, []).append(
+                    cls(**self._detector_params(cls, params)))
         return self
 
     def _append_locked(self, name: str, value) -> None:

@@ -152,9 +152,13 @@ def is_skipped(rec):
 #: predate them) simply contribute no point.
 #: ``cold_staged_rows_per_s`` (parallel-IO staging throughput) joins
 #: in round 13 — the QD/coalescing win is regression-tracked from
-#: the round that shipped it.
+#: the round that shipped it. ``gather_efficiency`` (qt-prof's
+#: roofline figure: modeled gather bytes / timed wall / probed
+#: random-gather peak, a 0..1 fraction) joins in round 14 — a stage
+#: drifting away from the hardware's limits fails the sweep even when
+#: absolute rows/s still looks plausible on a faster box.
 SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
-               "cold_staged_rows_per_s")
+               "cold_staged_rows_per_s", "gather_efficiency")
 
 
 def _points(rec):

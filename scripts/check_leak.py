@@ -73,6 +73,17 @@ count so the wrap is exercised (bounded memory for week-long runs),
 and the dedup-budget advisor must actually fire (the loop's unique
 counts overflow the store's budget — observed, not synthetic).
 
+Phase 10 pins the PROFILER (qt-prof): a full ``StageProfiler`` pass
+over the warmed quick-registry entries + the pipeline decomposition —
+machine probe taken, every stage timed best-of-N with donation-safe
+arg copies, records emitted through a sink and stage-share series fed
+into a hub — must add ZERO executables (the pass re-times the already
+compiled programs, never builds one), zero recompiles through its own
+jitted-fn watch, and leave live-array counts flat (the timing copies
+of donated states are transient). The profiler is a separate pass by
+construction; this phase is what makes "by construction" a measured
+fact.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -763,6 +774,56 @@ def main():
     hstore.close()
     print("no leak detected (phase 9: telemetry hub + detectors + "
           "advisor live, wrapped series rings)")
+
+    # ---- phase 10: a full qt-prof pass is free ----
+    # The profiler times the SAME compiled programs production runs;
+    # a pass over warmed entries must add zero executables, zero
+    # recompiles, and leave live arrays flat — donated-state timing
+    # copies included.
+    from quiver_tpu.profile import StageProfiler, machine_probe
+
+    prof_sink_path = os.path.join(tempfile.mkdtemp(), "prof.jsonl")
+    prof_sink = qm.MetricsSink(prof_sink_path)
+    prof_hub = TelemetryHub(capacity=32, window=4)
+    profiler = StageProfiler(reps=2, probe=machine_probe(quick=True),
+                             sink=prof_sink, hub=prof_hub)
+    profiler.add_registry(quick=True)
+    profiler.add_pipeline()
+    profiler.run()                 # warm pass: compiles every stage
+    pstats_watch = qm.StepStats()
+    pstats_watch.watch_compiles(*profiler.jitted_fns)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = sum(f._cache_size() for f in profiler.jitted_fns)
+
+    prof_recs = profiler.run()     # the measured pass
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = sum(f._cache_size() for f in profiler.jitted_fns) - base_cache
+    entries = [r["entry"] for r in prof_recs]
+    print(f"phase 10 live arrays: {base_arrays} -> {arrays}; "
+          f"profile-pass executable-cache growth: {grew}; "
+          f"recompiles seen by StepStats: "
+          f"{pstats_watch.snapshot()['recompiles']}; "
+          f"entries profiled: {entries}")
+    assert grew == 0, \
+        "the profile pass compiled something (it must only re-time " \
+        "the warmed programs)"
+    assert pstats_watch.snapshot()["recompiles"] == 0, \
+        "profiler recompile watch fired on the second pass"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak across a profile pass (donated-arg " \
+        "timing copies must be transient)"
+    assert "train_pipeline" in entries and "serve_step" in entries
+    share_series = [s for s in prof_hub.series
+                    if s.startswith("stage_share:")]
+    assert share_series, "profile pass fed no stage-share series"
+    with open(prof_sink_path) as f:
+        kinds = [_json.loads(l)["kind"] for l in f if l.strip()]
+    assert kinds and all(k == "profile" for k in kinds)
+    prof_sink.close()
+    print("no leak detected (phase 10: full qt-prof pass over warmed "
+          "entries — flat executables, flat arrays)")
 
 
 if __name__ == "__main__":

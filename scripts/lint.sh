@@ -74,29 +74,34 @@ if not slot_names:
     print("DRIFT: could not read SLOT_NAMES from quiver_tpu/metrics.py")
     fail = 1
 
-# telemetry contract: every detector kind and advice key the hub can
-# emit (module-level DETECTOR_NAMES / ADVICE_KEYS tuples) needs a
-# backticked row too — same mechanical-doc discipline as the slots
-ttree = ast.parse(pathlib.Path("quiver_tpu/telemetry.py").read_text())
-tel_names = {"DETECTOR_NAMES": [], "ADVICE_KEYS": []}
-for node in ast.walk(ttree):
-    if isinstance(node, ast.Assign):
-        for t in node.targets:
-            if isinstance(t, ast.Name) and t.id in tel_names and \
-                    isinstance(node.value, (ast.Tuple, ast.List)):
-                tel_names[t.id] = [e.value for e in node.value.elts
+# telemetry + profiler contracts: every detector kind / advice key the
+# hub can emit (DETECTOR_NAMES / ADVICE_KEYS) and every series-name
+# prefix the profiler feeds (PROFILE_SERIES in quiver_tpu/profile.py)
+# needs a backticked row too — same mechanical-doc discipline as slots
+def const_tuples(path, varnames):
+    tree = ast.parse(pathlib.Path(path).read_text())
+    found = {v: [] for v in varnames}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in found and \
+                        isinstance(node.value, (ast.Tuple, ast.List)):
+                    found[t.id] = [e.value for e in node.value.elts
                                    if isinstance(e, ast.Constant)]
-for group, names in tel_names.items():
-    if not names:
-        print(f"DRIFT: could not read {group} from "
-              "quiver_tpu/telemetry.py")
-        fail = 1
-    for name in names:
-        if f"`{name}`" not in doc:
-            print(f"DRIFT: {group} entry `{name}` "
-                  "(quiver_tpu/telemetry.py) has no row in "
-                  "docs/observability.md")
+    return found
+
+for path, varnames in (
+        ("quiver_tpu/telemetry.py", ("DETECTOR_NAMES", "ADVICE_KEYS")),
+        ("quiver_tpu/profile.py", ("PROFILE_SERIES",))):
+    for group, names in const_tuples(path, varnames).items():
+        if not names:
+            print(f"DRIFT: could not read {group} from {path}")
             fail = 1
+        for name in names:
+            if f"`{name}`" not in doc:
+                print(f"DRIFT: {group} entry `{name}` ({path}) has "
+                      "no row in docs/observability.md")
+                fail = 1
 
 def kind_literals(tree):
     for node in ast.walk(tree):

@@ -72,9 +72,9 @@ def _num(v):
 
 def build_series(records):
     """kind-keyed record stream -> {series name: [values]} plus the
-    event lists (anomalies, advice, regress, slo)."""
+    event lists (anomalies, advice, regress, lint, profile, slo)."""
     series = {}
-    anomalies, advice, regress, lint = [], {}, {}, {}
+    anomalies, advice, regress, lint, prof = [], {}, {}, {}, {}
     slo = None
 
     def put(name, v):
@@ -112,8 +112,16 @@ def build_series(records):
             if _num(rec.get("value")):
                 put(f"bench:{rec.get('metric', '?')}", rec["value"])
             for k in ("feature_gather_rows_per_s", "cold_rows_per_s",
-                      "prefetch_hit_rate"):
+                      "prefetch_hit_rate", "cold_staged_rows_per_s",
+                      "gather_efficiency"):
                 put(f"bench:{k}", rec.get(k))
+        elif kind == "profile":
+            # latest per (entry, stage) — repeated qt_prof passes
+            # re-emit every stage and must not flood the panel
+            entry = rec.get("entry", "?")
+            if not str(entry).startswith("__"):
+                for st in rec.get("stages") or []:
+                    prof[(entry, st.get("stage", "?"))] = st
         elif kind == "anomaly":
             anomalies.append(rec)
         elif kind == "advice":
@@ -125,7 +133,7 @@ def build_series(records):
             # latest per (rule, entry) — repeated suite runs re-emit
             # the same finding and must not flood the display window
             lint[(rec.get("rule", "?"), rec.get("entry", "?"))] = rec
-    return series, anomalies, advice, regress, lint, slo
+    return series, anomalies, advice, regress, lint, prof, slo
 
 
 def sparkline(values, width):
@@ -149,7 +157,7 @@ def render(path, limit, width, color=True):
     c = (lambda code, s: f"{code}{s}{RESET}") if color else \
         (lambda code, s: s)
     records = read_records(path, limit)
-    series, anomalies, advice, regress, lint, slo = \
+    series, anomalies, advice, regress, lint, prof, slo = \
         build_series(records)
     lines = [c(BOLD, f"qt_top — {path}  "
                      f"({len(records)} records, "
@@ -196,6 +204,22 @@ def render(path, limit, width, color=True):
                        f"  lint {rec.get('level')} "
                        f"[{rec.get('rule')}] {rec.get('entry')}: "
                        f"{rec.get('msg')}"))
+    for (entry, stage) in sorted(prof)[:12]:
+        st = prof[(entry, stage)]
+        eff = st.get("efficiency")
+        # efficiency colored by threshold: >=50% of the probed peak is
+        # healthy for a dispatch-bound stage, <15% is leaving the
+        # hardware idle
+        tint = (DIM if not _num(eff) else GREEN if eff >= 0.5
+                else YELLOW if eff >= 0.15 else RED)
+        eff_s = f"{100 * eff:.1f}% peak" if _num(eff) else "n/a"
+        share = st.get("share")
+        share_s = f"{100 * share:.0f}% of step" if _num(share) else ""
+        lines.append(c(tint,
+                       f"  prof [{entry}/{stage}]: "
+                       f"{st.get('mean_ms', 0)} ms  "
+                       f"{st.get('achieved_gbps', 0)} GB/s  "
+                       f"{eff_s}  {share_s}"))
     for (metric, platform) in sorted(regress):
         rec = regress[(metric, platform)]
         bad = bool(rec.get("regressed"))

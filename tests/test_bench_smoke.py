@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -81,6 +83,16 @@ def test_bench_cpu_smoke_json_contract(tmp_path):
     # staging throughput through the parallel-IO extent reader
     # (workers=2) — the third bench_regress trajectory group
     assert out["cold_staged_rows_per_s"] > 0
+    # qt-prof: gather roofline efficiency (modeled bytes / timed wall
+    # / probed same-pass random-gather peak — the fourth bench_regress
+    # trajectory group) + the coarse per-stage attribution block
+    assert 0.0 < out["gather_efficiency"] <= 2.0
+    assert out["gather_achieved_gbps"] > 0
+    assert out["probe_gather_gbps"] > 0
+    assert set(out["stage_ms"]) == {"sample", "gather", "cold_tier"}
+    assert all(v > 0 for v in out["stage_ms"].values())
+    assert sum(out["stage_shares"].values()) == pytest.approx(1.0,
+                                                              abs=0.01)
     assert out["vs_baseline"] is None
     assert "error" not in out
     # the same record also landed in the structured metrics log

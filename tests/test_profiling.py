@@ -1,0 +1,96 @@
+"""Direct coverage for ``quiver_tpu.profiling`` — the module qt-prof
+leans on (ScopeTimer feeds the scope spans/JSONL, ``hot_path`` is the
+host-lint contract marker, ``annotate`` wraps hot functions)."""
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from quiver_tpu import profiling
+from quiver_tpu.profiling import ScopeTimer, annotate, hot_path
+
+
+class TestScopeTimer:
+    def test_mean_of_unmeasured_name_does_not_pollute(self):
+        # the mutation-on-read bug class: reading a never-measured
+        # name off the defaultdicts must not insert a phantom 0.0 row
+        # that summary()/summary_dict() then report as a real scope
+        t = ScopeTimer()
+        with t.measure("real"):
+            pass
+        assert t.mean("never-measured") == 0.0
+        assert "never-measured" not in t.totals
+        assert "never-measured" not in t.counts
+        assert set(t.summary_dict()) == {"real"}
+        assert "never-measured" not in t.summary()
+
+    def test_mean_on_empty_timer(self):
+        t = ScopeTimer()
+        assert t.mean("anything") == 0.0
+        assert t.summary_dict() == {}
+        assert t.totals == {} and t.counts == {}
+
+    def test_measure_accumulates(self):
+        t = ScopeTimer()
+        for _ in range(3):
+            with t.measure("s"):
+                pass
+        assert t.counts["s"] == 3
+        assert t.totals["s"] >= 0.0
+        assert t.mean("s") == pytest.approx(t.totals["s"] / 3)
+
+    def test_measure_blocks_on_full_pytree(self):
+        # block_on takes a whole pytree (dict/tuple/leaf mix), not
+        # just a single array — jax.block_until_ready semantics
+        t = ScopeTimer()
+        tree = {"a": jnp.arange(8.0),
+                "b": (jnp.ones((4, 4)), jnp.zeros(3)),
+                "c": None}
+        with t.measure("tree", block_on=tree):
+            tree["a"] = tree["a"] * 2
+        assert t.counts["tree"] == 1
+        assert t.totals["tree"] > 0.0
+
+    def test_reset(self):
+        t = ScopeTimer()
+        with t.measure("x"):
+            pass
+        t.reset()
+        assert t.summary_dict() == {}
+
+
+class TestAnnotate:
+    def test_preserves_signature_and_identity(self):
+        def hot_fn(a, b=2, *, c: int = 3):
+            """The docstring."""
+            return a + b + c
+
+        wrapped = annotate("my_scope")(hot_fn)
+        assert inspect.signature(wrapped) == inspect.signature(hot_fn)
+        assert wrapped.__doc__ == "The docstring."
+        assert wrapped.__name__ == "hot_fn"
+        assert wrapped.__wrapped__ is hot_fn
+
+    def test_wrapped_fn_still_works_under_jit(self):
+        @annotate("scoped_add")
+        def f(a, b):
+            return a + b
+
+        out = jax.jit(f)(jnp.arange(4), jnp.arange(4))
+        assert (jax.device_get(out) == [0, 2, 4, 6]).all()
+
+
+class TestHotPath:
+    def test_stamps_without_wrapping(self):
+        def f(x):
+            return x
+
+        g = hot_path(f)
+        assert g is f                      # NO wrapper: identity kept
+        assert f.__qt_hot_path__ is True
+        assert f(7) == 7
+
+    def test_scope_is_jax_named_scope(self):
+        assert profiling.scope is jax.named_scope
