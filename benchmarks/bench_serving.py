@@ -25,6 +25,13 @@ delay is REAL — a closed-loop driver would hide it):
    one tracing-ON trial at 95% of the measured sustained rate must
    still sustain (zero rejects, p99 in budget, backlog drained) —
    i.e. tracing costs <= 5% of the sustained rate.
+4. **The fleet plane is free**: the ``fleet_ab`` block A/Bs the WHOLE
+   cross-process observability plane (``quiver_tpu.fleet``) —
+   detached (naked server) vs attached (tracing + per-request
+   propagated trace context + hub feed + 10 Hz snapshot emission to a
+   replica sink + a live 4 Hz ``FleetAggregator`` + one real
+   ``/metrics`` scrape), arms interleaved per rep — throughput with
+   the plane on must be within noise of off.
 
 Also sweeps ``batch_cap`` x ``max_wait_ms`` at a fixed offered load —
 the coalescing-deadline tradeoff surface (bigger batches amortize
@@ -135,15 +142,25 @@ def best_trial(reps):
 
 
 def open_loop_trial(qv, engine, rate_rps, duration_s, n_nodes, cfg,
-                    seed=0):
+                    seed=0, server_kw=None, on_server=None,
+                    inject_context=False):
     """Offer Poisson arrivals at ``rate_rps`` for ``duration_s`` against
     a fresh server over ``engine``; wait for every accepted request.
-    Returns the trial facts (accepted p99, rejects, variant mix...)."""
+    Returns the trial facts (accepted p99, rejects, variant mix...).
+
+    The fleet A/B's plane hooks: ``server_kw`` extends the
+    ``MicroBatchServer`` constructor (``hub=``), ``on_server(server)``
+    runs after construction and may return a zero-arg teardown called
+    before close (the attached arm starts its snapshot feeder there),
+    ``inject_context=True`` stamps every submit with a propagated
+    trace context (``tracing.inject``) like a remote client would."""
+    from quiver_tpu import tracing
     rng = np.random.default_rng(seed)
     n_arrivals = max(int(rate_rps * duration_s), 1)
     gaps = rng.exponential(1.0 / rate_rps, n_arrivals)
     node_ids = rng.integers(0, n_nodes, n_arrivals)
-    server = qv.MicroBatchServer(engine, cfg)
+    server = qv.MicroBatchServer(engine, cfg, **(server_kw or {}))
+    teardown = on_server(server) if on_server is not None else None
     futs, rejects = [], 0
     t0 = time.perf_counter()
     t_next = t0
@@ -158,13 +175,16 @@ def open_loop_trial(qv, engine, rate_rps, duration_s, n_nodes, cfg,
         if delay > 0.0015:
             time.sleep(delay - 0.001)
         try:
-            futs.append(server.submit(int(node_ids[k])))
+            ctx = tracing.inject({}) if inject_context else None
+            futs.append(server.submit(int(node_ids[k]), context=ctx))
         except qv.OverloadError:
             rejects += 1
     t_offered = time.perf_counter() - t0
     for f in futs:
         f.result(timeout=120)
     t_drained = time.perf_counter() - t0
+    if teardown is not None:
+        teardown()
     snap = server.snapshot()
     server.close()
     req = snap.get("request", {})
@@ -229,6 +249,108 @@ def find_sustained(qv, engine, budget_ms, n_nodes, cfg, start_rps,
         else:
             failed = mid
     return (best["completed_rps"] if best else 0.0), best, trials
+
+
+def fleet_plane_ab(qv, engine, cfg, rate, trial_s, n_nodes, best_of,
+                   budget_ms):
+    """A/B the WHOLE cross-process observability plane against a naked
+    server at a stable operating point (half the sustained rate — the
+    same reasoning as the tracing A/B: at the capacity edge the p99 is
+    a queueing cliff, not a measurement).
+
+    Detached arm: the production default — no hub, tracing off, no
+    emission. Attached arm: everything the fleet plane adds at once —
+    tracing ON with a propagated trace context injected per request
+    (the remote-client path through ``submit(context=)``), the server
+    feeding a ``TelemetryHub``, a feeder thread emitting ``serving``
+    snapshots to a replica ``MetricsSink`` every 100 ms, a live
+    ``FleetAggregator`` polling that sink at 4 Hz, and one real
+    ``/metrics`` HTTP scrape through the ``FleetExporter`` per arm.
+    Arms run INTERLEAVED (off/on per rep) — this box's scheduler
+    drifts minute-to-minute, and interleaving is what keeps the ratio
+    honest."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    from quiver_tpu import fleet as qfleet
+    from quiver_tpu import tracing
+    from quiver_tpu.metrics import MetricsSink
+
+    d = tempfile.mkdtemp(prefix="qt_fleet_ab_")
+    rpath = os.path.join(d, "replica.jsonl")
+    sink = MetricsSink(rpath, replica="bench-r0")
+    agg = qfleet.FleetAggregator({"bench-r0": rpath}, interval_s=0.25,
+                                 stale_after_s=60.0)
+    agg.start()
+    exp = qfleet.FleetExporter(agg, port=0)
+
+    def on_server(server):
+        stop = threading.Event()
+
+        def feeder():
+            while not stop.wait(0.1):
+                server.emit(sink)
+
+        th = threading.Thread(target=feeder, daemon=True,
+                              name="qt-fleet-ab-feeder")
+        th.start()
+
+        def teardown():
+            stop.set()
+            th.join()
+            server.emit(sink)       # final snapshot: sink advances to
+            return None             # the trial's true end state
+        return teardown
+
+    off_reps, on_reps = [], []
+    try:
+        for r in range(best_of):
+            off_reps.append(open_loop_trial(
+                qv, engine, rate, trial_s, n_nodes, cfg, seed=700 + r))
+            tracing.clear()
+            tracing.enable()
+            try:
+                hub = qv.TelemetryHub(watches=())
+                on_reps.append(open_loop_trial(
+                    qv, engine, rate, trial_s, n_nodes, cfg,
+                    seed=800 + r, server_kw={"hub": hub},
+                    on_server=on_server, inject_context=True))
+            finally:
+                tracing.disable()
+        t0 = time.perf_counter()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/metrics",
+            timeout=10).read().decode()
+        scrape_ms = 1e3 * (time.perf_counter() - t0)
+        scrape_ok = ('qt_replica_health{replica="bench-r0"}' in body
+                     and "qt_series" in body)
+        fleet_snap = agg.snapshot()
+    finally:
+        tracing.clear()
+        exp.close()
+        agg.close()
+        sink.close()
+
+    def arm(reps):
+        t = best_trial(reps)
+        t["sustained"] = is_sustained(t, budget_ms, trial_s)
+        return {k: t[k] for k in ("completed_rps", "p50_ms", "p99_ms",
+                                  "rejected", "sustained")}
+
+    off, on = arm(off_reps), arm(on_reps)
+    return {
+        "rate_rps": round(rate, 1),
+        "detached": off,
+        "attached": on,
+        "rps_ratio": (round(on["completed_rps"]
+                            / off["completed_rps"], 4)
+                      if off["completed_rps"] else None),
+        "scrape_ok": scrape_ok,
+        "scrape_ms": round(scrape_ms, 2),
+        "replica_health": fleet_snap["replicas"]["bench-r0"]["health"],
+        "fleet_status": fleet_snap["fleet"]["status"],
+    }
 
 
 def accuracy_tradeoff(qv, jax, engine, n_nodes, probes=512, reps=2):
@@ -427,6 +549,10 @@ def main():
                           "off": ab_off_near, "on": ab_on_near},
     }
 
+    # -- fleet observability plane A/B (attached vs detached) ----------------
+    fleet_ab = fleet_plane_ab(qv, co_engine, co_cfg, ab_rate, trial_s,
+                              n_nodes, best_of, budget_ms)
+
     # -- batch-size x deadline sweep at half the sustained load --------------
     sweep = []
     sweep_rate = max(co_rps / 2.0, 16.0)
@@ -456,6 +582,7 @@ def main():
         overload=overload,
         fanout_argmax_agreement=agree,
         trace_ab=trace_ab,
+        fleet_ab=fleet_ab,
         sweep=sweep,
         trials={"serial": serial_trials, "coalesced": co_trials},
         elapsed_s=round(time.time() - t_start, 1),

@@ -7,8 +7,8 @@
 # evidence pipeline commits it with -f).
 #
 # Usage: sh benchmarks/chip_suite.sh [section ...]
-#   sections: verify prof bench dispatch sampler gather tiered offload
-#             io e2e exchange mixed hetero micro ablate regress
+#   sections: verify prof fleet bench dispatch sampler gather tiered
+#             offload io e2e exchange mixed hetero micro ablate regress
 #   default       = every section
 #   quick         = bench only (the metric of record; also warms the
 #                   compile cache for a later full sweep)
@@ -24,7 +24,7 @@ export QT_METRICS_JSONL
 SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-verify prof bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
+SECTIONS="${*:-verify prof fleet bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -54,6 +54,15 @@ fi
 # bench history so qt_top shows the stage panel in the same view
 if want prof; then
     step env JAX_PLATFORMS=cpu python -u scripts/qt_prof.py --quick --jsonl "$QT_METRICS_JSONL"
+fi
+
+# fleet observability plane smoke (qt-agg): synthesize two replica
+# sinks (one crossing a rollover seam), aggregate, scrape the real
+# /metrics + /healthz endpoints, validate the Prometheus exposition —
+# CPU-only like verify/prof (never claims the chip); the fleet/anomaly
+# records land beside the bench history so qt_top --fleet shows them
+if want fleet; then
+    step env JAX_PLATFORMS=cpu python -u scripts/qt_agg.py --smoke --no-color --jsonl "$QT_METRICS_JSONL"
 fi
 
 # metric of record: the full default sweep (pair/sort, overlap/sort,

@@ -56,8 +56,9 @@ from .serving import (MicroBatchServer, OverloadError, ServeConfig,
                       ServeEngine, build_serve_step)
 from .telemetry import FlightRecorder, PlanContext, TelemetryHub
 from .profile import StageProfiler, machine_probe
+from .fleet import FleetAggregator, FleetExporter, health_score
 from . import (analysis, comm, profiling, checkpoint, datasets, debug,
-               metrics, profile, serving, telemetry, tracing)
+               fleet, metrics, profile, serving, telemetry, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -130,4 +131,7 @@ __all__ = [
     "FlightRecorder",
     "StageProfiler",
     "machine_probe",
+    "FleetAggregator",
+    "FleetExporter",
+    "health_score",
 ]

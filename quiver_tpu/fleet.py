@@ -1,0 +1,594 @@
+"""Cross-process observability plane: fleet telemetry aggregation,
+per-replica health scoring, and a Prometheus/health export endpoint.
+
+Every observability leg so far is single-process: the tracer ring, the
+``StepStats``/``SloBudget`` snapshots, the ``TelemetryHub`` series all
+describe the process that owns them. A serving FLEET (N replica
+processes behind the future shed-aware router — ROADMAP frontier 4)
+needs one global picture, and this module builds it OUT of the
+per-process pieces instead of adding a new protocol: every replica
+already leaves a ``MetricsSink`` JSONL file (self-attributing since
+the ``meta`` header record), so the fleet plane is a reader, not a
+wire format.
+
+Three layers:
+
+- :class:`FleetAggregator` — tails N replicas' sink files
+  (``metrics.read_jsonl`` across each file's rollover seam) and folds
+  them through ``TelemetryHub.ingest_records`` into one
+  :class:`~quiver_tpu.telemetry.TelemetryHub` PER REPLICA plus one
+  fleet-global hub (cumulative counters diffed per source, gauge
+  points high-water-marked — re-polling a growing file never double
+  counts). Each poll scores every replica's health
+  (:func:`health_score`: SLO burn rate, shed level, staleness) and a
+  replica whose sink stops advancing is *detected* — its health drops
+  to 0 and one ``anomaly`` record (detector ``staleness``) is emitted
+  on the transition, never assumed healthy. One ``fleet`` JSONL record
+  per poll carries the whole verdict (``scripts/qt_top.py --fleet``
+  renders it).
+- :func:`health_score` — the deterministic formula the future router
+  consumes: ``0`` when stale, else ``1 - 0.5*min(1, max(0, burn-1))
+  - 0.5*min(1, shed_frac)`` — burning the error budget faster than
+  sustainable and shedding quality each cost up to half the score;
+  a replica at sustainable burn and full quality scores 1.0.
+- :class:`FleetExporter` — a stdlib ``http.server`` endpoint:
+  ``/metrics`` in Prometheus text exposition format (per-replica
+  health/staleness gauges, per-replica AND fleet-global series last
+  values, counter totals) and ``/healthz`` returning the fleet verdict
+  as JSON (HTTP 503 only when every replica is stale — a degraded
+  fleet is still a live aggregator).
+
+Everything here is host-side file reading on its own thread — nothing
+touches a jitted program, so the hot-path invariants (zero host syncs,
+bit-identity, donation, flat executable caches) hold by construction;
+``bench_serving.py``'s ``fleet_ab`` block measures the attached-plane
+cost as within noise anyway.
+
+Usage (one aggregator over three replica sinks)::
+
+    agg = FleetAggregator({"r0": "r0.jsonl", "r1": "r1.jsonl",
+                           "r2": "r2.jsonl"}, interval_s=2.0,
+                          sink=MetricsSink("fleet.jsonl"))
+    agg.start()                      # background polling
+    exp = FleetExporter(agg, port=9109)
+    # curl localhost:9109/metrics | promtool check metrics
+    ...
+    exp.close(); agg.close()
+
+``scripts/qt_agg.py`` is the CLI wrapper.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+import weakref
+from typing import List, Optional, Sequence, Tuple
+
+from . import metrics as _metrics
+from .telemetry import TelemetryHub
+
+__all__ = ["FleetAggregator", "FleetExporter", "health_score",
+           "prometheus_text"]
+
+
+def health_score(burn: Optional[float] = None, shed_frac: float = 0.0,
+                 stale: bool = False,
+                 age_s: Optional[float] = None) -> Tuple[float, dict]:
+    """The per-replica health formula (0 worst .. 1 best) the router
+    will route/drain on — deterministic, so a score is arguable from
+    its inputs:
+
+    - ``stale`` (the replica's sink stopped advancing): score 0. A
+      silent replica is DOWN until proven otherwise — routing traffic
+      at a process that stopped reporting is how fleets black-hole.
+    - ``burn`` (the worse of the replica's short/long SLO burn rates):
+      burning at or below 1.0 is sustainable and free; past it the
+      penalty grows linearly to 0.5 at burn 2.0 (twice as fast as the
+      SLO tolerates = half the health gone).
+    - ``shed_frac`` (current shed level / ladder depth): full-quality
+      serving is free; serving the cheapest variant costs 0.5.
+
+    Returns ``(score, components)`` — the components dict records each
+    input and penalty so a ``fleet`` record is self-explaining."""
+    burn_pen = 0.5 * min(1.0, max(0.0, (burn or 0.0) - 1.0))
+    shed_pen = 0.5 * min(1.0, max(0.0, float(shed_frac)))
+    score = 0.0 if stale else max(0.0, 1.0 - burn_pen - shed_pen)
+    components = {
+        "stale": bool(stale),
+        "burn": None if burn is None else round(float(burn), 4),
+        "burn_penalty": round(burn_pen, 4),
+        "shed_frac": round(float(shed_frac), 4),
+        "shed_penalty": round(shed_pen, 4),
+    }
+    if age_s is not None:
+        components["age_s"] = round(float(age_s), 3)
+    return round(score, 4), components
+
+
+class _Replica:
+    """One replica's aggregation state (internal)."""
+
+    def __init__(self, name: str, path, capacity: int, window: int):
+        self.name = name
+        self.path = str(path)
+        self.hub = TelemetryHub(capacity=capacity, window=window,
+                                watches=())
+        self.meta: Optional[dict] = None
+        self.last_serving: Optional[dict] = None
+        self.records = 0          # kind-matching records ever folded
+        self.last_new: Optional[float] = None   # clock of last advance
+        self.stale = False
+        self.health = 1.0
+        self.components: dict = {}
+
+
+class FleetAggregator:
+    """Tail N replicas' ``MetricsSink`` JSONL files into per-replica
+    and fleet-global :class:`TelemetryHub` series + health scores.
+
+    ``replicas`` is ``{name: sink_path}`` (or a path list — names
+    default to ``r0..rN-1``). ``poll()`` runs one aggregation pass and
+    returns the fleet snapshot; ``start()`` spins a daemon thread
+    polling every ``interval_s`` until :meth:`close` (idempotent, also
+    reaped by a finalizer). A replica with no new records for
+    ``stale_after_s`` (default ``3 * interval_s``) is STALE: health 0,
+    one ``anomaly`` record (detector ``staleness``) emitted on the
+    transition; it recovers the moment its sink advances again.
+
+    ``sink`` (a ``metrics.MetricsSink``) receives one ``fleet`` record
+    per poll plus the staleness anomalies; the fleet-global hub also
+    emits its own detector ``anomaly`` records through it (regime
+    shifts visible only in the merged series).
+
+    Each poll re-reads every replica sink whole (the fold is
+    idempotent, only the tail is ingested) — so long-running replicas
+    should write SIZE-BOUNDED sinks (``MetricsSink(max_bytes=...)``),
+    which caps a poll's parse work at ``2 * max_bytes`` per replica
+    forever; an unbounded sink makes polls grow linearly with its
+    history. Poll passes are serialized on their own lock, and the
+    scored state the exporter snapshots is guarded separately, so a
+    slow poll (or a slow sink disk) never stalls a ``/metrics`` or
+    ``/healthz`` answer."""
+
+    def __init__(self, replicas, interval_s: float = 2.0,
+                 stale_after_s: Optional[float] = None,
+                 sink=None, capacity: int = 512, window: int = 8,
+                 kinds: Sequence[str] = TelemetryHub.INGEST_KINDS,
+                 clock=None):
+        if isinstance(replicas, dict):
+            items = list(replicas.items())
+        else:
+            items = [(f"r{i}", p) for i, p in enumerate(replicas)]
+        if not items:
+            raise ValueError("need at least one replica sink path")
+        names = [n for n, _ in items]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names in {names}")
+        self.interval_s = float(interval_s)
+        self.stale_after_s = (float(stale_after_s)
+                              if stale_after_s is not None
+                              else 3.0 * self.interval_s)
+        self.sink = sink
+        self.kinds = tuple(kinds)
+        self._clock = clock if clock is not None else time.monotonic
+        self.fleet = TelemetryHub(capacity=capacity, window=window,
+                                  sink=sink)
+        self._replicas: "collections.OrderedDict[str, _Replica]" = \
+            collections.OrderedDict(
+                (n, _Replica(n, p, capacity, window)) for n, p in items)
+        self.anomalies: "collections.deque" = collections.deque(
+            maxlen=64)
+        self.polls = 0
+        self._t_start = self._clock()
+        # two locks: _poll_lock serializes whole aggregation passes
+        # (file reads + hub folds + any sink emission the fleet hub's
+        # detectors do — all the slow work); _lock guards only the
+        # scored replica state and is held for microseconds, so the
+        # exporter threads' snapshot() calls under /metrics and
+        # /healthz can never be stalled by a slow disk
+        self._poll_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._finalizer = weakref.finalize(self, self._stop.set)
+
+    # -- one aggregation pass -----------------------------------------------
+    def _poll_replica(self, r: _Replica, now: float) -> int:
+        recs = _metrics.read_jsonl(r.path)
+        # provenance + serve-shape facts the hubs don't retain: the
+        # newest meta header names the writer, the newest serving
+        # record carries the shed-ladder depth the health score
+        # normalizes by
+        for rec in recs:
+            kind = rec.get("kind")
+            if kind == "meta":
+                r.meta = {k: rec.get(k)
+                          for k in ("host", "pid", "start_ts",
+                                    "replica") if k in rec}
+            elif kind == "serving":
+                r.last_serving = rec
+        n = r.hub.ingest_records(recs, r.path, self.kinds)
+        self.fleet.ingest_records(recs, f"{r.name}:{r.path}",
+                                  self.kinds)
+        r.records += n
+        if n:
+            r.last_new = now
+        return n
+
+    def _score_replica(self, r: _Replica, now: float) -> Optional[dict]:
+        since = r.last_new if r.last_new is not None else self._t_start
+        age = now - since
+        was_stale = r.stale
+        r.stale = age > self.stale_after_s
+        burns = [r.hub.series[s].last()
+                 for s in ("slo_burn_short", "slo_burn_long")
+                 if s in r.hub.series]
+        burns = [b for b in burns if b is not None]
+        burn = max(burns) if burns else None
+        shed_s = r.hub.series.get("serve_shed_level")
+        shed = shed_s.last() if shed_s is not None else None
+        ladder = 1
+        if r.last_serving is not None:
+            variants = (r.last_serving.get("serving") or {}).get(
+                "fanout_variants") or []
+            ladder = max(len(variants) - 1, 1)
+        r.health, r.components = health_score(
+            burn=burn, shed_frac=(shed or 0.0) / ladder,
+            stale=r.stale, age_s=age)
+        if r.stale and not was_stale:
+            rec = {"series": f"replica_health:{r.name}",
+                   "detector": "staleness", "replica": r.name,
+                   "value": round(age, 3),
+                   "baseline": round(self.stale_after_s, 3),
+                   "shift": round(age - self.stale_after_s, 3),
+                   "step": r.records}
+            self.anomalies.append(rec)
+            return rec
+        return None
+
+    def poll(self) -> dict:
+        """One aggregation pass over every replica sink; returns (and
+        ``fleet``-emits) the fleet snapshot. Thread-safe — the
+        background loop and an on-scrape caller may race harmlessly
+        (passes are serialized; both do the same idempotent fold)."""
+        staleness: List[dict] = []
+        with self._poll_lock:
+            # the slow half (file reads, JSON parses, hub folds, the
+            # fleet hub's own detector emissions) runs OUTSIDE the
+            # state lock — only poll passes contend on it
+            now = self._clock()
+            for r in self._replicas.values():
+                self._poll_replica(r, now)
+            with self._lock:
+                for r in self._replicas.values():
+                    hit = self._score_replica(r, now)
+                    if hit is not None:
+                        staleness.append(hit)
+                self.polls += 1
+                snap = self._snapshot_locked(now)
+        # sink emission AFTER every lock releases (the host-lint
+        # lock_held_emit contract): a slow sink disk must not stall
+        # the exporter threads snapshotting concurrently
+        if self.sink is not None:
+            for rec in staleness:
+                self.sink.emit(rec, kind="anomaly")
+            self.sink.emit(snap, kind="fleet")
+        return snap
+
+    def _snapshot_locked(self, now: float) -> dict:
+        reps = {}
+        for r in self._replicas.values():
+            since = r.last_new if r.last_new is not None \
+                else self._t_start
+            reps[r.name] = {
+                "path": r.path,
+                "health": r.health,
+                "stale": r.stale,
+                "age_s": round(now - since, 3),
+                "records": r.records,
+                "components": dict(r.components),
+                "meta": r.meta,
+            }
+        healths = [v["health"] for v in reps.values()]
+        n_stale = sum(1 for v in reps.values() if v["stale"])
+        if n_stale == len(reps):
+            status = "down"
+        elif n_stale or min(healths) < 0.5:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "replicas": reps,
+            "fleet": {
+                "status": status,
+                "replica_count": len(reps),
+                "stale_count": n_stale,
+                "health_min": round(min(healths), 4),
+                "health_mean": round(sum(healths) / len(healths), 4),
+                "polls": self.polls,
+            },
+        }
+
+    def snapshot(self) -> dict:
+        """The latest fleet verdict WITHOUT re-reading any file (ages
+        advance against the live clock)."""
+        with self._lock:
+            return self._snapshot_locked(self._clock())
+
+    def replica_hub(self, name: str) -> TelemetryHub:
+        """The named replica's merged :class:`TelemetryHub`."""
+        return self._replicas[name].hub
+
+    @property
+    def replica_names(self) -> List[str]:
+        return list(self._replicas)
+
+    # -- life cycle ----------------------------------------------------------
+    def start(self) -> "FleetAggregator":
+        """Spin the background polling thread (daemon — dies with the
+        process; ``close()`` reaps it deterministically)."""
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("aggregator is closed")
+            if self._thread is None:
+                t = threading.Thread(target=self._loop,
+                                     name="qt-fleet-agg", daemon=True)
+                t.start()
+                self._thread = t
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and not self._stop.is_set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll()
+            except Exception:         # a torn file mid-write must not
+                continue              # kill the plane; next poll heals
+
+    def close(self) -> None:
+        """Stop the polling thread and join it. Idempotent."""
+        self._stop.set()
+        t = self._thread
+        self._thread = None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+
+    def __enter__(self) -> "FleetAggregator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+
+def _prom_escape(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return repr(f) if f != int(f) else str(int(f))
+
+
+def prometheus_text(agg: FleetAggregator) -> str:
+    """Render the aggregator's state in Prometheus text exposition
+    format (version 0.0.4 — what a ``/metrics`` scrape returns):
+
+    - ``qt_replica_health`` / ``qt_replica_stale`` /
+      ``qt_replica_age_seconds`` / ``qt_replica_records_total``
+      gauges+counters, one sample per replica;
+    - ``qt_fleet_replicas`` / ``qt_fleet_stale_replicas`` /
+      ``qt_fleet_health_min`` / ``qt_fleet_health_mean`` /
+      ``qt_fleet_polls_total`` fleet rollups;
+    - ``qt_series`` — every hub series' LAST value, labeled
+      ``{replica=..., name=...}`` per replica and ``{name=...}``
+      (no replica label) for the fleet-global fold;
+    - ``qt_counter_total`` — the cumulative device-counter totals with
+      the same labeling.
+
+    Series names ride in a label (not the metric name), so arbitrary
+    in-tree series names (``stage_share:<entry>/<stage>``) can never
+    produce an invalid exposition."""
+    snap = agg.snapshot()
+    lines: List[str] = []
+
+    def head(name, typ, help_):
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {typ}")
+
+    head("qt_replica_health", "gauge",
+         "Replica health score (0 worst .. 1 best; 0 when stale).")
+    for name, r in snap["replicas"].items():
+        lines.append(f'qt_replica_health{{replica="'
+                     f'{_prom_escape(name)}"}} '
+                     f'{_fmt_value(r["health"])}')
+    head("qt_replica_stale", "gauge",
+         "1 when the replica's sink stopped advancing.")
+    for name, r in snap["replicas"].items():
+        lines.append(f'qt_replica_stale{{replica="'
+                     f'{_prom_escape(name)}"}} {int(r["stale"])}')
+    head("qt_replica_age_seconds", "gauge",
+         "Seconds since the replica's sink last advanced.")
+    for name, r in snap["replicas"].items():
+        lines.append(f'qt_replica_age_seconds{{replica="'
+                     f'{_prom_escape(name)}"}} '
+                     f'{_fmt_value(r["age_s"])}')
+    head("qt_replica_records_total", "counter",
+         "Telemetry records aggregated from the replica's sink.")
+    for name, r in snap["replicas"].items():
+        lines.append(f'qt_replica_records_total{{replica="'
+                     f'{_prom_escape(name)}"}} {int(r["records"])}')
+    fl = snap["fleet"]
+    for metric, typ, key, help_ in (
+            ("qt_fleet_replicas", "gauge", "replica_count",
+             "Replicas the aggregator watches."),
+            ("qt_fleet_stale_replicas", "gauge", "stale_count",
+             "Replicas whose sinks stopped advancing."),
+            ("qt_fleet_health_min", "gauge", "health_min",
+             "Worst replica health score."),
+            ("qt_fleet_health_mean", "gauge", "health_mean",
+             "Mean replica health score."),
+            ("qt_fleet_polls_total", "counter", "polls",
+             "Aggregation passes completed.")):
+        head(metric, typ, help_)
+        lines.append(f"{metric} {_fmt_value(fl[key])}")
+
+    head("qt_series", "gauge",
+         "Last value of each telemetry series (no replica label = "
+         "the fleet-global fold).")
+
+    def series_lines(hub, replica: Optional[str]):
+        label = (f'replica="{_prom_escape(replica)}",'
+                 if replica is not None else "")
+        for sname in sorted(hub.series):
+            last = hub.series[sname].last()
+            if last is None:
+                continue
+            lines.append(f'qt_series{{{label}name="'
+                         f'{_prom_escape(sname)}"}} '
+                         f'{_fmt_value(last)}')
+
+    for name in agg.replica_names:
+        series_lines(agg.replica_hub(name), name)
+    series_lines(agg.fleet, None)
+
+    head("qt_counter_total", "counter",
+         "Cumulative device-counter totals (no replica label = the "
+         "fleet-global add/max fold).")
+
+    def counter_lines(hub, replica: Optional[str]):
+        label = (f'replica="{_prom_escape(replica)}",'
+                 if replica is not None else "")
+        named = _metrics.counters_dict(hub.counters())
+        for cname, val in sorted(named.items()):
+            if not val:
+                continue
+            lines.append(f'qt_counter_total{{{label}name="'
+                         f'{_prom_escape(cname)}"}} {int(val)}')
+
+    for name in agg.replica_names:
+        counter_lines(agg.replica_hub(name), name)
+    counter_lines(agg.fleet, None)
+    return "\n".join(lines) + "\n"
+
+
+# -- the export endpoint ------------------------------------------------------
+
+
+class FleetExporter:
+    """Stdlib HTTP endpoint over a :class:`FleetAggregator`:
+
+    - ``GET /metrics`` — :func:`prometheus_text` (content type
+      ``text/plain; version=0.0.4``). If the aggregator has no
+      background thread running, the scrape itself polls — scrape-time
+      aggregation is the Prometheus-idiomatic mode.
+    - ``GET /healthz`` — the fleet verdict as JSON (the aggregator
+      snapshot). HTTP 200 while at least one replica is alive
+      (``ok``/``degraded``), 503 when the whole fleet is stale
+      (``down``) — a load balancer probing the plane should only
+      fail over when there is truly nothing left to route to.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port`` —
+    what tests use). ``close()`` shuts the server down and joins its
+    thread; also bound to a finalizer."""
+
+    def __init__(self, agg: FleetAggregator, host: str = "127.0.0.1",
+                 port: int = 0, start: bool = True):
+        import http.server
+
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib contract)
+                try:
+                    exporter._respond(self)
+                except BrokenPipeError:
+                    pass               # scraper hung up mid-answer
+
+            def log_message(self, *a):
+                pass                   # scrapes must not spam stderr
+
+        self.agg = agg
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self._finalizer = weakref.finalize(
+            self, FleetExporter._shutdown, self._httpd)
+        if start:
+            self.start()
+
+    @staticmethod
+    def _shutdown(httpd) -> None:
+        try:
+            # shutdown() blocks on an event only serve_forever() sets:
+            # calling it on a server whose loop never ran (constructed
+            # with start=False, never started) would hang forever —
+            # including from the finalizer at interpreter exit
+            if getattr(httpd, "_qt_serving", False):
+                httpd.shutdown()
+            httpd.server_close()
+        except Exception:
+            pass
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def _respond(self, handler) -> None:
+        path = handler.path.split("?", 1)[0]
+        if path == "/metrics":
+            if not self.agg.running:
+                self.agg.poll()
+            body = prometheus_text(self.agg).encode()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            if not self.agg.running:
+                self.agg.poll()
+            snap = self.agg.snapshot()
+            body = (json.dumps(snap) + "\n").encode()
+            code = 503 if snap["fleet"]["status"] == "down" else 200
+            handler.send_response(code)
+            handler.send_header("Content-Type", "application/json")
+        else:
+            body = b"not found (try /metrics or /healthz)\n"
+            handler.send_response(404)
+            handler.send_header("Content-Type", "text/plain")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def start(self) -> "FleetExporter":
+        if self._thread is None:
+            self._httpd._qt_serving = True
+            t = threading.Thread(target=self._httpd.serve_forever,
+                                 name="qt-fleet-export", daemon=True)
+            t.start()
+            self._thread = t
+        return self
+
+    def close(self) -> None:
+        """Shut the HTTP server down and join its thread. Idempotent."""
+        FleetExporter._shutdown(self._httpd)
+        t = self._thread
+        self._thread = None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+
+    def __enter__(self) -> "FleetExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

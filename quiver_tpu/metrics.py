@@ -45,10 +45,13 @@ block with per-REQUEST admission->result latency percentiles and a
 stage timings), ``anomaly`` / ``advice``
 (``telemetry.TelemetryHub`` — change-point detections and advisory
 re-planning records), ``regress`` (``scripts/bench_regress.py`` —
-per-trajectory-group verdicts), and ``profile``
+per-trajectory-group verdicts), ``profile``
 (``quiver_tpu.profile.StageProfiler`` / ``scripts/qt_prof.py`` —
-per-entry stage timings, modeled bytes, roofline efficiency).
-Consumers key on ``kind`` and must ignore unknown fields;
+per-entry stage timings, modeled bytes, roofline efficiency),
+``meta`` (:class:`MetricsSink`'s self-attribution header — host, pid,
+start_ts, replica), and ``fleet`` (``quiver_tpu.fleet`` — per-replica
+health scores + fleet-global rollup from the cross-process
+aggregator). Consumers key on ``kind`` and must ignore unknown fields;
 ``scripts/lint.sh`` pins that every kind and every counter slot has a
 row in docs/observability.md.
 """
@@ -681,16 +684,30 @@ class MetricsSink:
     window read the seam: :func:`read_jsonl` (and ``scripts/qt_top.py``
     / ``scripts/bench_regress.py``) consume ``<path>.1`` before
     ``<path>``.
+
+    Path-owned sinks are SELF-ATTRIBUTING: the first emit (and the
+    first emit into each post-rollover file) is preceded by one
+    ``meta`` header record — ``{host, pid, start_ts, replica}``
+    (``replica`` from the constructor arg or ``QT_REPLICA``) — so a
+    fleet aggregator tailing N replicas' files knows who wrote each
+    one without filename conventions. Readers key on ``kind`` and must
+    ignore unknown kinds, so old files without the header (and
+    consumers that predate it) keep working.
     """
 
     def __init__(self, path, kind: str = "record",
-                 max_bytes: Optional[int] = None):
+                 max_bytes: Optional[int] = None,
+                 replica: Optional[str] = None):
         self._own = isinstance(path, (str, bytes, os.PathLike))
         self._path = os.fspath(path) if self._own else None
         self._f = open(path, "a") if self._own else path
         self._kind = kind
         self._max_bytes = (int(max_bytes)
                            if max_bytes and self._own else None)
+        self._replica = (str(replica) if replica
+                         else os.environ.get("QT_REPLICA") or None)
+        self._start_ts = time.time()
+        self._meta_written = not self._own
         self._lock = threading.Lock()
 
     def emit(self, record: dict, kind: Optional[str] = None) -> dict:
@@ -699,11 +716,27 @@ class MetricsSink:
         rec.update({k: v for k, v in record.items() if k != "kind"})
         line = json.dumps(rec, default=_json_default)
         with self._lock:
+            if not self._meta_written:
+                self._meta_written = True
+                self._write_meta_locked()
             self._f.write(line + "\n")
             self._f.flush()
             if self._max_bytes and self._f.tell() >= self._max_bytes:
                 self._rollover_locked()
         return rec
+
+    def _write_meta_locked(self, kind: str = "meta") -> None:
+        # the self-attribution header: who is writing this file. Lazy
+        # (first emit, not __init__) so a sink that never emits leaves
+        # no file noise, and re-written after each rollover so BOTH
+        # halves of the seam carry their provenance.
+        import socket
+        rec = {"ts": round(time.time(), 3), "kind": kind,
+               "host": socket.gethostname(), "pid": os.getpid(),
+               "start_ts": round(self._start_ts, 3)}
+        if self._replica:
+            rec["replica"] = self._replica
+        self._f.write(json.dumps(rec, default=_json_default) + "\n")
 
     def _rollover_locked(self) -> None:
         # whole-record boundary by construction: rollover happens only
@@ -711,6 +744,7 @@ class MetricsSink:
         self._f.close()
         os.replace(self._path, self._path + ".1")
         self._f = open(self._path, "a")
+        self._write_meta_locked()
 
     def emit_stats(self, stats: StepStats, kind: str = "step_stats") -> dict:
         return self.emit(stats.snapshot(), kind=kind)

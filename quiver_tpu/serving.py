@@ -528,19 +528,31 @@ class MicroBatchServer:
         return self._closed
 
     # -- admission ----------------------------------------------------------
-    def submit(self, node_id: int):
+    def submit(self, node_id: int, context=None):
         """Admit one point query; returns a ``Future`` resolving to the
         node's logits row (numpy ``[out_dim]``). Raises
         :class:`OverloadError` IMMEDIATELY when the admission queue is
         full — rejecting at the door is the overload policy's last
-        stage (see :class:`ServeConfig`)."""
+        stage (see :class:`ServeConfig`).
+
+        ``context`` is optional request metadata carrying a propagated
+        trace context (``tracing.inject`` on the client side): when
+        tracing is on, this request's spans record under the CLIENT's
+        ``trace_id`` instead of a locally minted one, so the client's
+        and this replica's exported traces correlate in one merged
+        Perfetto view (``tracing.merge_chrome_traces``). A missing or
+        mangled context falls back to a local id — never an error."""
         if self._closed:
             raise RuntimeError("server is closed")
         from concurrent.futures import Future
         fut: Future = Future()
-        req = _Request(int(node_id), fut, time.perf_counter(),
-                       tracing.new_trace_id() if tracing.enabled()
-                       else None)
+        tid = None
+        if tracing.enabled():
+            ctx = tracing.extract(context) if context is not None \
+                else None
+            tid = ctx.trace_id if ctx is not None \
+                else tracing.new_trace_id()
+        req = _Request(int(node_id), fut, time.perf_counter(), tid)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -566,16 +578,17 @@ class MicroBatchServer:
             self._counts["requests"] += 1
         return fut
 
-    def submit_many(self, node_ids) -> list:
-        """``submit`` per id. If admission overloads mid-list the
-        raised :class:`OverloadError` carries the already-admitted
-        futures on ``.futures`` — admitted work runs regardless, so its
-        results must stay observable (and a retry must not resubmit
-        them)."""
+    def submit_many(self, node_ids, context=None) -> list:
+        """``submit`` per id (one shared ``context`` — a multi-point
+        client operation traces as ONE request id across its points).
+        If admission overloads mid-list the raised
+        :class:`OverloadError` carries the already-admitted futures on
+        ``.futures`` — admitted work runs regardless, so its results
+        must stay observable (and a retry must not resubmit them)."""
         futs: list = []
         for i in node_ids:
             try:
-                futs.append(self.submit(i))
+                futs.append(self.submit(i, context=context))
             except OverloadError as e:
                 e.futures = futs
                 raise
@@ -781,6 +794,26 @@ class MicroBatchServer:
                                 "variant": variant})
 
     # -- observability ------------------------------------------------------
+    def health(self) -> dict:
+        """This replica's own health verdict — the same
+        ``fleet.health_score`` formula the cross-process aggregator
+        applies to every replica (SLO burn rate + shed level; a live
+        server is never stale to itself), so a replica's self-report
+        and the fleet view can only disagree about staleness, which
+        only an outside observer can judge. Returns ``{"score",
+        "components"}``."""
+        from .fleet import health_score
+        burn = None
+        if self.slo is not None:
+            s = self.slo.burn_rate(self.slo.short_window_s)
+            l = self.slo.burn_rate(self.slo.window_s)
+            rates = [r for r in (s, l) if r is not None]
+            burn = max(rates) if rates else None
+        top = max(len(self.engine.variants) - 1, 1)
+        score, components = health_score(
+            burn=burn, shed_frac=self._shed_level / top)
+        return {"score": score, "components": components}
+
     def snapshot(self) -> dict:
         """One JSONL-ready record (kind ``serving``): the underlying
         ``StepStats`` snapshot (per-request AND per-batch latency
@@ -805,6 +838,7 @@ class MicroBatchServer:
             "queue_depth": self._q.qsize(),
             "shed_level": self._shed_level,
             "fanout_variants": [list(v) for v in self.engine.variants],
+            "health": self.health()["score"],
         }
         return rec
 

@@ -408,6 +408,16 @@ class TelemetryHub:
         self._compile_fns: List = []
         self._compile_last: Optional[int] = None
         self._source_last: Dict[str, np.ndarray] = {}
+        # per-source high-water marks for ingest_records/ingest_jsonl:
+        # (count, fingerprint of the first kind-matching record) — how
+        # many records have already been folded from each source, so
+        # re-reading a growing sink file ingests only the tail (gauge
+        # points would otherwise double-count — the cumulative-counter
+        # diff only protects the counter slots). The fingerprint
+        # detects a rollover that dropped old records while appending
+        # at least as many new ones: the count alone would read that
+        # as pure growth and silently skip the genuinely-new tail.
+        self._ingest_marks: Dict[str, tuple] = {}
         self.anomalies: "collections.deque" = collections.deque(
             maxlen=int(max_log))
         self.advice: Dict[str, dict] = {}
@@ -620,17 +630,63 @@ class TelemetryHub:
                 self._append_locked("step_ms", wall["p50_ms"])
         self._drain_emits()
 
-    def ingest_jsonl(self, path, kinds=("step_stats",)) -> int:
+    #: the sink-file record kinds :meth:`ingest_jsonl` folds by
+    #: default: counter-bearing ``step_stats``, plus the serve-side
+    #: health a fleet merge needs — ``serving`` (a step_stats payload
+    #: with request percentiles / queue depth / shed level) and ``slo``
+    #: (burn rates)
+    INGEST_KINDS = ("step_stats", "serving", "slo")
+
+    def ingest_records(self, recs, source: str,
+                       kinds=INGEST_KINDS) -> int:
+        """Fold an already-read record list from one ``source``.
+        Idempotent across re-ingests of a growing stream: the hub keeps
+        a per-source high-water mark (count of kind-matching records
+        already folded) and only the tail past it is ingested — calling
+        this every poll interval on the same ever-longer list never
+        double-counts a gauge point, and the cumulative ``counters``
+        blocks additionally diff per source (:meth:`ingest_snapshot`).
+        If the visible stream's PREFIX changed (a second sink rollover
+        replaced ``<path>.1``, dropping the oldest records — detected
+        by count shrink or a changed first-record fingerprint even
+        when enough new records arrived to mask the shrink), the mark
+        resets and everything visible is re-folded — counter totals
+        stay exact (the diff guards them); gauge series may repeat a
+        few points in that rare case.
+        Returns the number of records ingested this call."""
+        import json as _json
+        picked = [r for r in recs if r.get("kind") in kinds]
+        head = (_json.dumps(picked[0], sort_keys=True, default=str)
+                if picked else None)
+        with self._lock:
+            mark, prev_head = self._ingest_marks.get(source, (0, None))
+            if len(picked) < mark or (mark and head != prev_head):
+                mark = 0                 # prefix changed: rollover
+            self._ingest_marks[source] = (len(picked), head)
+        fresh = picked[mark:]
+        for rec in fresh:
+            kind = rec.get("kind")
+            if kind == "slo":
+                self.ingest_slo(rec)
+                continue
+            # cumulative-diff state is per (source, kind): a sink that
+            # interleaves step_stats and serving records carries TWO
+            # independent cumulative counter streams (two StepStats),
+            # and diffing them against each other would corrupt both
+            self.ingest_snapshot(rec, source=f"{source}#{kind}")
+            if kind == "serving":
+                self.ingest_serving(rec)
+        return len(fresh)
+
+    def ingest_jsonl(self, path, kinds=INGEST_KINDS) -> int:
         """Fold a per-host sink file (rotated sibling ``path.1`` first,
         then ``path`` — the ``MetricsSink`` rollover seam). Returns the
-        number of records ingested. This is the cross-host merge path
+        number of NEW records ingested (the per-source high-water mark
+        makes repeated calls on a growing file fold only the tail —
+        see :meth:`ingest_records`). This is the cross-host merge path
         for deployments that share files instead of a mesh axis."""
-        n = 0
-        for rec in _metrics.read_jsonl(path):
-            if rec.get("kind") in kinds:
-                self.ingest_snapshot(rec, source=str(path))
-                n += 1
-        return n
+        return self.ingest_records(_metrics.read_jsonl(path),
+                                   str(path), kinds)
 
     # -- subsystem feeds -----------------------------------------------------
     def ingest_slo(self, slo) -> None:

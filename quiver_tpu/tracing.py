@@ -42,6 +42,23 @@ Perfetto UI (https://ui.perfetto.dev) and ``chrome://tracing`` load
 directly: complete (``"ph": "X"``) events on named thread tracks, span
 ``args`` (including ``trace_id``) visible in the selection panel.
 
+**Cross-process propagation** (the fleet plane's tracing leg): a span
+timeline is per-process, but a REQUEST crosses processes — a client
+submits, a serve replica answers. :func:`inject` stamps a compact
+trace context (``trace_id``, optional parent span name, the sender's
+replica label) into any dict-shaped request metadata; the receiving
+side calls :func:`extract` and continues recording under the SAME
+``trace_id`` (``serving.MicroBatchServer.submit(node_id, context=...)``
+does this). Injected ids are *globally* unique — the pid rides the
+high bits (:meth:`Tracer.new_global_trace_id`) so ids minted by
+different clients/replicas never collide in a merged trace. Each
+process exports with its own real ``pid`` plus a ``process_name``
+metadata row (the replica label, ``QT_REPLICA`` / :func:`set_replica`
+/ the ``replica=`` export arg), and :func:`merge_chrome_traces`
+concatenates N exports into one file — Perfetto renders one process
+track group per replica, and searching the injected ``trace_id``
+lights up the request's spans across every process that touched it.
+
 Usage::
 
     from quiver_tpu import tracing
@@ -49,6 +66,13 @@ Usage::
     with tracing.span("stage.load", args={"rows": 4096}):
         ...
     tracing.export_chrome_trace("/tmp/trace.json")   # -> Perfetto
+
+    # client process:
+    meta = tracing.inject({})                  # -> request metadata
+    # replica process (its spans carry meta's trace_id):
+    ctx = tracing.extract(meta)
+    with tracing.span("serve.request", trace_id=ctx.trace_id):
+        ...
 """
 
 from __future__ import annotations
@@ -59,11 +83,43 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 Record = Tuple[str, int, float, float, Optional[int], Optional[dict]]
 
 DEFAULT_CAPACITY = 65536
+
+# the compact carrier keys inject()/extract() use inside request
+# metadata — namespaced so they coexist with application fields
+CTX_TRACE_ID = "qt.trace_id"
+CTX_PARENT = "qt.parent"
+CTX_REPLICA = "qt.replica"
+
+
+class TraceContext(NamedTuple):
+    """The propagated trace context: the correlation id a client
+    minted, the span name it was under (informational), and the
+    SENDER's replica label."""
+
+    trace_id: int
+    parent: Optional[str] = None
+    replica: Optional[str] = None
+
+
+# the process's replica label (fleet identity): QT_REPLICA env, or
+# set_replica(); stamps outgoing contexts and the Perfetto export's
+# process_name row
+_replica: Optional[str] = os.environ.get("QT_REPLICA") or None
+
+
+def set_replica(name: Optional[str]) -> None:
+    """Set this process's replica label (overrides ``QT_REPLICA``)."""
+    global _replica
+    _replica = str(name) if name else None
+
+
+def get_replica() -> Optional[str]:
+    return _replica
 
 
 class _NullSpan:
@@ -153,6 +209,16 @@ class Tracer:
         """A fresh correlation id (process-unique, monotonic)."""
         return next(self._ids)
 
+    def new_global_trace_id(self) -> int:
+        """A fresh correlation id safe to PROPAGATE across processes:
+        the pid rides the high bits above the local counter, so two
+        replicas (or a client and a replica) can each mint ids and a
+        merged fleet trace still has no collisions. Same int domain as
+        :meth:`new_trace_id` — span records don't care which minted
+        theirs."""
+        return ((os.getpid() & 0x3FFFFF) << 24) | \
+            (next(self._ids) & 0xFFFFFF)
+
     def record(self, name: str, t0: float, dur: float,
                trace_id: Optional[int] = None,
                args: Optional[dict] = None) -> None:
@@ -187,16 +253,28 @@ class Tracer:
         recs.sort(key=lambda r: r[2])
         return recs
 
-    def export_chrome_trace(self, path: str) -> int:
+    def export_chrome_trace(self, path: str,
+                            replica: Optional[str] = None) -> int:
         """Write the retained spans as Chrome trace-event JSON (the
         format Perfetto / ``chrome://tracing`` load). Returns the number
         of span events written. Timestamps are ``perf_counter``-relative
-        microseconds — offsets within the trace are what matter."""
+        microseconds — offsets within the trace are what matter.
+
+        Every event carries this process's real ``pid`` and the export
+        leads with a ``process_name`` metadata row (``replica`` arg,
+        else the process replica label, else ``pid <n>``) — so N
+        replicas' exports merged into one file
+        (:func:`merge_chrome_traces`) render one labeled process track
+        group each instead of collapsing into anonymous processes."""
         pid = os.getpid()
+        label = replica if replica is not None else _replica
         # copy before iterating: recorder threads (pipeline workers, a
         # live coalescer) may register a first-seen tid mid-export —
         # iterating the live dict would raise and lose the whole trace
         events: List[dict] = [
+            {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+             "args": {"name": label or f"pid {pid}"}}]
+        events += [
             {"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
              "args": {"name": tname}}
             for tid, tname in sorted(self._tid_names.copy().items())]
@@ -252,6 +330,100 @@ def new_trace_id() -> int:
     return _tracer.new_trace_id()
 
 
+def new_global_trace_id() -> int:
+    return _tracer.new_global_trace_id()
+
+
+# -- cross-process propagation ------------------------------------------------
+
+
+def inject(carrier: Optional[dict] = None,
+           trace_id: Optional[int] = None,
+           parent: Optional[str] = None,
+           replica: Optional[str] = None) -> dict:
+    """Stamp a compact trace context into ``carrier`` (request
+    metadata — any JSON-able dict; created when ``None``) and return
+    it. ``trace_id`` defaults to a fresh GLOBAL id
+    (:func:`new_global_trace_id` — pid-prefixed, collision-free across
+    a fleet); ``replica`` defaults to this process's label. The
+    receiving process hands the carrier to :func:`extract` (or to
+    ``MicroBatchServer.submit(node_id, context=carrier)``) and its
+    spans continue under the same ``trace_id``."""
+    if carrier is None:
+        carrier = {}
+    carrier[CTX_TRACE_ID] = int(trace_id) if trace_id is not None \
+        else new_global_trace_id()
+    if parent is not None:
+        carrier[CTX_PARENT] = str(parent)
+    label = replica if replica is not None else _replica
+    if label is not None:
+        carrier[CTX_REPLICA] = str(label)
+    return carrier
+
+
+def extract(carrier) -> Optional[TraceContext]:
+    """Read a trace context out of request metadata. Tolerant by
+    design: ``None``, a non-dict, a dict without the context keys, or
+    a mangled id all return ``None`` — a request without a usable
+    context is simply untraced, never an error."""
+    if not isinstance(carrier, dict):
+        return None
+    raw = carrier.get(CTX_TRACE_ID)
+    try:
+        tid = int(raw)
+    except (TypeError, ValueError):
+        return None
+    parent = carrier.get(CTX_PARENT)
+    replica = carrier.get(CTX_REPLICA)
+    return TraceContext(tid,
+                        str(parent) if parent is not None else None,
+                        str(replica) if replica is not None else None)
+
+
+def merge_chrome_traces(paths: Sequence[str], out_path: str) -> int:
+    """Merge N per-process Chrome trace exports into ONE file Perfetto
+    loads whole — the fleet view: one process track group per replica
+    (each export's ``process_name`` metadata row names it), request
+    spans correlated across groups by the propagated ``trace_id``.
+    Two exports claiming the same pid (pid reuse across hosts or
+    restarts) are disambiguated by offsetting the later file's pids —
+    labels and intra-file structure are preserved. Returns the total
+    number of events written. Files that fail to parse are skipped (a
+    half-written export from a dying replica must not lose the rest
+    of the fleet's trace)."""
+    events: List[dict] = []
+    used_pids: set = set()
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+            evs = doc["traceEvents"] if isinstance(doc, dict) else doc
+            if not isinstance(evs, list):
+                continue
+        except (OSError, ValueError, KeyError):
+            continue
+        file_pids = {e.get("pid") for e in evs
+                     if isinstance(e, dict) and "pid" in e}
+        remap: Dict[int, int] = {}
+        for fp in sorted(x for x in file_pids if isinstance(x, int)):
+            np_ = fp
+            while np_ in used_pids:
+                np_ += 1 << 22          # above the pid namespace
+            remap[fp] = np_
+            used_pids.add(np_)
+        for e in evs:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            if isinstance(e.get("pid"), int):
+                e["pid"] = remap.get(e["pid"], e["pid"])
+            events.append(e)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"},
+                  f, default=str)
+    return len(events)
+
+
 def record(name: str, t0: float, dur: float,
            trace_id: Optional[int] = None,
            args: Optional[dict] = None) -> None:
@@ -267,8 +439,8 @@ def records() -> List[Record]:
     return _tracer.records()
 
 
-def export_chrome_trace(path: str) -> int:
-    return _tracer.export_chrome_trace(path)
+def export_chrome_trace(path: str, replica: Optional[str] = None) -> int:
+    return _tracer.export_chrome_trace(path, replica=replica)
 
 
 # QT_TRACE=1 turns recording on; QT_TRACE=<path> additionally exports

@@ -459,10 +459,12 @@ def main():
     assert snap["steps"] == 51 and snap["counters"]["frontier_cap"] > 0
     with open(sink_path) as f:
         lines = [l for l in f if l.strip()]
-    assert len(lines) == 5, f"expected 5 JSONL records, got {len(lines)}"
+    # 5 data records + the sink's self-attribution meta header
+    assert len(lines) == 6, f"expected 6 JSONL records, got {len(lines)}"
     import json as _json
     rec = _json.loads(lines[-1])
     assert rec["kind"] == "step_stats" and "counters" in rec
+    assert _json.loads(lines[0])["kind"] == "meta"
     mstore.close()
     print("no leak detected (phase 5: metrics-on pipelined lookups + "
           "donated metered steps)")
@@ -820,6 +822,7 @@ def main():
     assert share_series, "profile pass fed no stage-share series"
     with open(prof_sink_path) as f:
         kinds = [_json.loads(l)["kind"] for l in f if l.strip()]
+    kinds = [k for k in kinds if k != "meta"]    # the sink's header
     assert kinds and all(k == "profile" for k in kinds)
     prof_sink.close()
     print("no leak detected (phase 10: full qt-prof pass over warmed "

@@ -14,7 +14,12 @@ render, in place, one compact frame per refresh:
   advisory re-planner's recommendations), the latest ``regress``
   verdicts from the bench sentinel, and ``lint`` findings from
   ``scripts/qt_verify.py`` (ERROR red, WARN yellow — the static
-  invariant verifier's verdicts).
+  invariant verifier's verdicts);
+- the FLEET panel when the sink carries ``fleet`` records (point it at
+  ``scripts/qt_agg.py``'s ``--jsonl``): one row per replica — health
+  score colored by threshold, STALE flagged red — plus the fleet
+  status line. ``--fleet`` narrows the frame to that panel (the
+  multi-replica operator view).
 
 Reads across the sink's rollover seam (``<path>.1`` before ``<path>``,
 the ``MetricsSink(max_bytes=...)`` convention), so a size-bounded
@@ -72,10 +77,12 @@ def _num(v):
 
 def build_series(records):
     """kind-keyed record stream -> {series name: [values]} plus the
-    event lists (anomalies, advice, regress, lint, profile, slo)."""
+    event lists (anomalies, advice, regress, lint, profile, slo,
+    fleet)."""
     series = {}
     anomalies, advice, regress, lint, prof = [], {}, {}, {}, {}
     slo = None
+    fleet = None
 
     def put(name, v):
         if _num(v):
@@ -122,6 +129,12 @@ def build_series(records):
             if not str(entry).startswith("__"):
                 for st in rec.get("stages") or []:
                     prof[(entry, st.get("stage", "?"))] = st
+        elif kind == "fleet":
+            # newest verdict wins; per-replica health becomes a series
+            # so the panel shows the TREND, not just the last score
+            fleet = rec
+            for name, r in (rec.get("replicas") or {}).items():
+                put(f"health:{name}", r.get("health"))
         elif kind == "anomaly":
             anomalies.append(rec)
         elif kind == "advice":
@@ -133,7 +146,7 @@ def build_series(records):
             # latest per (rule, entry) — repeated suite runs re-emit
             # the same finding and must not flood the display window
             lint[(rec.get("rule", "?"), rec.get("entry", "?"))] = rec
-    return series, anomalies, advice, regress, lint, prof, slo
+    return series, anomalies, advice, regress, lint, prof, slo, fleet
 
 
 def sparkline(values, width):
@@ -153,11 +166,46 @@ def fmt(v):
     return f"{v:.3f}"
 
 
-def render(path, limit, width, color=True):
+def render_fleet(fleet, series, width, c):
+    """The multi-replica panel: fleet status line + one row per
+    replica (health trend sparkline, score colored by threshold,
+    STALE red)."""
+    lines = []
+    fl = fleet.get("fleet") or {}
+    status = fl.get("status", "?")
+    tint = {"ok": GREEN, "degraded": YELLOW}.get(status, RED)
+    lines.append(c(tint, (
+        f"fleet: {fl.get('replica_count', '?')} replicas, status "
+        f"{status} (health min {fl.get('health_min', '?')} / mean "
+        f"{fl.get('health_mean', '?')}, {fl.get('stale_count', 0)} "
+        f"stale)")))
+    reps = fleet.get("replicas") or {}
+    name_w = max((len(n) for n in reps), default=0)
+    for name in sorted(reps):
+        r = reps[name]
+        h = r.get("health")
+        stale = bool(r.get("stale"))
+        tint = (RED if stale or not _num(h) or h < 0.4
+                else YELLOW if h < 0.75 else GREEN)
+        trend = series.get(f"health:{name}", [])
+        spark = sparkline(trend, width) if trend else ""
+        comp = r.get("components") or {}
+        burn = comp.get("burn")
+        lines.append(c(tint, (
+            f"  {name:<{name_w}}  {spark:<{width}}  health "
+            f"{h if _num(h) else '?'}"
+            f"{'  STALE' if stale else ''}  "
+            f"age {r.get('age_s', '?')}s  "
+            f"burn {burn if _num(burn) else 'n/a'}  "
+            f"shed {comp.get('shed_frac', 0)}")))
+    return lines
+
+
+def render(path, limit, width, color=True, fleet_only=False):
     c = (lambda code, s: f"{code}{s}{RESET}") if color else \
         (lambda code, s: s)
     records = read_records(path, limit)
-    series, anomalies, advice, regress, lint, prof, slo = \
+    series, anomalies, advice, regress, lint, prof, slo, fleet = \
         build_series(records)
     lines = [c(BOLD, f"qt_top — {path}  "
                      f"({len(records)} records, "
@@ -166,6 +214,20 @@ def render(path, limit, width, color=True):
         lines.append("  (no records yet — is QT_METRICS_JSONL set and "
                      "the run emitting?)")
         return "\n".join(lines)
+    def anomaly_lines():
+        return [c(RED, f"  ANOMALY [{a.get('detector')}] "
+                       f"{a.get('series')}: "
+                       f"{a.get('baseline')} -> {a.get('value')} "
+                       f"(step {a.get('step')})")
+                for a in anomalies[-6:]]
+
+    if fleet_only:
+        if fleet is None:
+            lines.append("  (no fleet records — point --jsonl at "
+                         "scripts/qt_agg.py's sink)")
+        else:
+            lines += render_fleet(fleet, series, width, c)
+        return "\n".join(lines + anomaly_lines())
     name_w = max((len(n) for n in series), default=0)
     for name in sorted(series):
         v = series[name]
@@ -186,11 +248,9 @@ def render(path, limit, width, color=True):
         if shedding:
             txt += "  SHEDDING"
         lines.append(c(RED if shedding else GREEN, txt))
-    for a in anomalies[-6:]:
-        lines.append(c(RED, f"  ANOMALY [{a.get('detector')}] "
-                           f"{a.get('series')}: "
-                           f"{a.get('baseline')} -> {a.get('value')} "
-                           f"(step {a.get('step')})"))
+    if fleet is not None:
+        lines += render_fleet(fleet, series, width, c)
+    lines += anomaly_lines()
     for key in sorted(advice):
         rec = advice[key]
         lines.append(c(YELLOW, f"  advice [{key}]: "
@@ -245,6 +305,9 @@ def main(argv=None):
                     help="sparkline width (points)")
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit (no screen control)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="multi-replica view: only the fleet panel "
+                         "(point --jsonl at qt_agg's sink)")
     ap.add_argument("--no-color", action="store_true")
     args = ap.parse_args(argv)
     # color keys on the terminal, never on the mode: `--once >> log`
@@ -252,12 +315,13 @@ def main(argv=None):
     color = not args.no_color and bool(sys.stdout.isatty()
                                        or os.environ.get("FORCE_COLOR"))
     if args.once:
-        print(render(args.jsonl, args.limit, args.width, color=color))
+        print(render(args.jsonl, args.limit, args.width, color=color,
+                     fleet_only=args.fleet))
         return 0
     try:
         while True:
             frame = render(args.jsonl, args.limit, args.width,
-                           color=color)
+                           color=color, fleet_only=args.fleet)
             # home, draw (clearing each line's stale tail), then clear
             # only BELOW the new frame — a full pre-clear would blank
             # the screen before the frame text arrives (per-interval

@@ -106,7 +106,7 @@ def test_bench_cpu_smoke_json_contract(tmp_path):
     assert bench_recs[0]["value"] == out["value"]
     assert isinstance(bench_recs[0]["ts"], float)
     for r in recs:
-        assert r["kind"] in ("bench", "advice")
+        assert r["kind"] in ("meta", "bench", "advice")
         if r["kind"] == "advice":
             assert r["recommended"] != r["current"] and r["reason"]
 
@@ -183,6 +183,15 @@ def test_bench_serving_smoke_json_contract(tmp_path):
     ov = out["overload"]
     assert ov["rate_rps"] > 0 and ov["p99_ms"] > 0
     assert len(ov["variant_batches"]) == 3       # the shed ladder
+    # the fleet-plane A/B ran both arms and the live /metrics scrape
+    # against the attached plane answered in valid form
+    fab = out["fleet_ab"]
+    assert fab["detached"]["completed_rps"] > 0
+    assert fab["attached"]["completed_rps"] > 0
+    assert fab["rps_ratio"] and fab["rps_ratio"] > 0
+    assert fab["scrape_ok"] is True
+    assert fab["fleet_status"] in ("ok", "degraded")
+    assert 0.0 <= fab["replica_health"] <= 1.0
     assert isinstance(ov["p99_bounded"], bool)
     # accuracy/fanout tradeoff: full fanout vs itself is the noise
     # floor; every ladder entry reports an agreement fraction
@@ -192,6 +201,7 @@ def test_bench_serving_smoke_json_contract(tmp_path):
     # mirrored into the structured metrics log with the shared schema
     with open(sink_path) as f:
         recs = [json.loads(l) for l in f if l.strip()]
+    recs = [r for r in recs if r["kind"] != "meta"]    # sink header
     assert len(recs) == 1
     assert recs[0]["kind"] == "bench"
     assert recs[0]["value"] == out["value"]

@@ -1,0 +1,816 @@
+"""Fleet observability plane: trace propagation, aggregation, export.
+
+The contracts under test:
+
+1. **Trace-context propagation** — ``tracing.inject``/``extract``
+   round-trip a compact context through request metadata; injected ids
+   are globally unique (pid-prefixed); garbage carriers extract to
+   ``None``, never raise. Exports stamp the real pid + a
+   ``process_name`` row per replica, and ``merge_chrome_traces``
+   builds one multi-process file (remapping pid collisions).
+2. **Self-attributing sinks** — ``MetricsSink`` writes one ``meta``
+   header record ({host, pid, start_ts, replica}) on first emit and
+   again after each rollover, so BOTH halves of the seam carry
+   provenance; readers that key on ``kind`` are unaffected.
+3. **Fleet merge** — ``TelemetryHub.ingest_jsonl`` folds ``serving``
+   and ``slo`` records beside ``step_stats``; re-ingesting a growing
+   file folds only the tail (no gauge double counting), and the
+   cumulative-counter diff keeps totals exact.
+4. **The aggregator** — 3 REAL emitter processes write sinks (one
+   crossing a rollover seam, one going silent mid-run); the
+   aggregator merges them into per-replica + fleet-global series, the
+   fleet counters match the hand-folded truth, and the silent
+   replica's health collapses to 0 with one ``staleness`` anomaly
+   within one aggregation interval — detected, not assumed healthy.
+5. **The export plane** — ``/metrics`` is valid Prometheus text
+   exposition (every sample typed, grammar-checked) with per-replica
+   AND fleet-global series; ``/healthz`` returns the JSON verdict
+   (503 only when the whole fleet is stale).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import importlib.util
+
+import pytest
+
+import quiver_tpu.fleet as qf
+from quiver_tpu import metrics as qm
+from quiver_tpu import telemetry as qt
+from quiver_tpu import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# 1. trace-context propagation
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_inject_extract_round_trip(self):
+        carrier = tracing.inject({}, trace_id=1234, parent="client.op",
+                                 replica="client-a")
+        ctx = tracing.extract(carrier)
+        assert ctx == tracing.TraceContext(1234, "client.op",
+                                           "client-a")
+
+    def test_inject_defaults_mint_global_pid_prefixed_id(self):
+        a = tracing.extract(tracing.inject({}))
+        b = tracing.extract(tracing.inject({}))
+        assert a.trace_id != b.trace_id
+        assert a.trace_id >> 24 == os.getpid() & 0x3FFFFF
+        assert b.trace_id >> 24 == os.getpid() & 0x3FFFFF
+
+    def test_inject_preserves_application_fields(self):
+        carrier = {"node_id": 7, "deadline_ms": 50}
+        out = tracing.inject(carrier, trace_id=9)
+        assert out is carrier
+        assert carrier["node_id"] == 7
+        assert tracing.extract(carrier).trace_id == 9
+
+    def test_extract_tolerates_garbage(self):
+        assert tracing.extract(None) is None
+        assert tracing.extract("not a dict") is None
+        assert tracing.extract({}) is None
+        assert tracing.extract({tracing.CTX_TRACE_ID: "zz"}) is None
+        # a stringified int (the context crossed a text protocol) works
+        assert tracing.extract(
+            {tracing.CTX_TRACE_ID: "41"}).trace_id == 41
+
+    def test_replica_label_defaults(self, monkeypatch):
+        monkeypatch.setattr(tracing, "_replica", None)
+        assert tracing.get_replica() is None
+        tracing.set_replica("serve-3")
+        try:
+            assert tracing.extract(
+                tracing.inject({})).replica == "serve-3"
+        finally:
+            tracing.set_replica(None)
+
+
+class TestChromeExportReplica:
+    def _export(self, tmp_path, name, replica):
+        tr = tracing.Tracer(capacity=16)
+        tr.enable()
+        tr.record("serve.request", 0.0, 0.001, 77)
+        p = str(tmp_path / name)
+        tr.export_chrome_trace(p, replica=replica)
+        return p
+
+    def test_process_name_metadata_row(self, tmp_path):
+        p = self._export(tmp_path, "t.json", "replica-9")
+        doc = json.load(open(p))
+        meta = [e for e in doc["traceEvents"]
+                if e.get("name") == "process_name"]
+        assert meta and meta[0]["args"]["name"] == "replica-9"
+        assert meta[0]["pid"] == os.getpid()
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert spans and all(e["pid"] == os.getpid() for e in spans)
+
+    def test_default_label_is_pid(self, tmp_path):
+        p = self._export(tmp_path, "t.json", None)
+        doc = json.load(open(p))
+        meta = [e for e in doc["traceEvents"]
+                if e.get("name") == "process_name"]
+        assert meta[0]["args"]["name"] == f"pid {os.getpid()}"
+
+    def test_merge_remaps_colliding_pids(self, tmp_path):
+        # two replicas' exports from THIS process share a pid — the
+        # merge must keep them as two distinct process track groups
+        pa = self._export(tmp_path, "a.json", "ra")
+        pb = self._export(tmp_path, "b.json", "rb")
+        out = str(tmp_path / "merged.json")
+        n = tracing.merge_chrome_traces([pa, pb], out)
+        doc = json.load(open(out))
+        assert n == len(doc["traceEvents"])
+        names = {e["pid"]: e["args"]["name"]
+                 for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert sorted(names.values()) == ["ra", "rb"]
+        assert len(names) == 2           # distinct pids post-merge
+        # every span still belongs to a labeled process
+        for e in doc["traceEvents"]:
+            if e.get("ph") == "X":
+                assert e["pid"] in names
+
+    def test_merge_skips_corrupt_file(self, tmp_path):
+        pa = self._export(tmp_path, "a.json", "ra")
+        bad = tmp_path / "bad.json"
+        bad.write_text("{torn")
+        out = str(tmp_path / "merged.json")
+        n = tracing.merge_chrome_traces([str(bad), pa], out)
+        assert n > 0
+        doc = json.load(open(out))
+        assert any(e.get("name") == "process_name"
+                   for e in doc["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# 2. the self-attributing sink header
+# ---------------------------------------------------------------------------
+
+
+class TestSinkMetaHeader:
+    def test_first_record_is_meta(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with qm.MetricsSink(path, replica="r7") as sink:
+            sink.emit({"x": 1}, kind="record")
+        recs = qm.read_jsonl(path)
+        assert recs[0]["kind"] == "meta"
+        assert recs[0]["pid"] == os.getpid()
+        assert recs[0]["replica"] == "r7"
+        assert isinstance(recs[0]["host"], str) and recs[0]["host"]
+        assert recs[0]["start_ts"] <= recs[0]["ts"] + 1e-3
+        assert recs[1] == {**recs[1], "kind": "record", "x": 1}
+
+    def test_replica_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("QT_REPLICA", "env-replica")
+        path = str(tmp_path / "m.jsonl")
+        with qm.MetricsSink(path) as sink:
+            sink.emit({"x": 1})
+        assert qm.read_jsonl(path)[0]["replica"] == "env-replica"
+
+    def test_no_replica_key_when_unset(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("QT_REPLICA", raising=False)
+        path = str(tmp_path / "m.jsonl")
+        with qm.MetricsSink(path) as sink:
+            sink.emit({"x": 1})
+        assert "replica" not in qm.read_jsonl(path)[0]
+
+    def test_never_emitting_writes_no_header(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        qm.MetricsSink(path).close()
+        assert qm.read_jsonl(path) == []
+
+    def test_filelike_sink_gets_no_header(self):
+        import io
+        buf = io.StringIO()
+        qm.MetricsSink(buf).emit({"x": 1})
+        recs = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert [r["kind"] for r in recs] == ["record"]
+
+    def test_rollover_reheaders_both_halves(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with qm.MetricsSink(path, max_bytes=500, replica="rr") as sink:
+            for i in range(30):
+                sink.emit({"i": i, "pad": "x" * 40}, kind="record")
+        for p in (path + ".1", path):
+            recs = [json.loads(l) for l in open(p) if l.strip()]
+            assert recs[0]["kind"] == "meta", f"{p} lost its header"
+            assert recs[0]["replica"] == "rr"
+        # the data stream across the seam is still chronological and
+        # the newest record survives
+        idx = [r["i"] for r in qm.read_jsonl(path)
+               if r.get("kind") == "record"]
+        assert idx == sorted(idx) and idx[-1] == 29
+
+
+# ---------------------------------------------------------------------------
+# 3. hub ingestion of serving/slo + re-ingest idempotence
+# ---------------------------------------------------------------------------
+
+
+class TestIngestServingSlo:
+    def _write(self, path, recs):
+        with open(path, "a") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    def test_default_kinds_fold_serving_and_slo(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        self._write(p, [
+            {"kind": "meta", "host": "h", "pid": 1},
+            {"kind": "serving",
+             "counters": {"hot_rows": 40, "cold_rows": 10},
+             "request": {"p99_ms": 12.5},
+             "serving": {"queue_depth": 3, "shed_level": 1,
+                         "mean_batch_fill": 6.0}},
+            {"kind": "slo",
+             "windows": {"short": {"burn_rate": 1.5},
+                         "long": {"burn_rate": 0.75}},
+             "budget_remaining": 0.5},
+        ])
+        hub = qt.TelemetryHub(watches=())
+        assert hub.ingest_jsonl(p) == 2           # meta not a kind
+        assert hub.series["serve_request_p99_ms"].last() == 12.5
+        assert hub.series["serve_shed_level"].last() == 1.0
+        assert hub.series["slo_burn_short"].last() == 1.5
+        assert hub.counters()[qm.HOT_ROWS] == 40
+
+    def test_reingest_never_double_counts(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        self._write(p, [
+            {"kind": "serving", "counters": {"hot_rows": 40},
+             "serving": {"queue_depth": 3, "shed_level": 0,
+                         "mean_batch_fill": 6.0}},
+            {"kind": "slo", "windows": {"short": {"burn_rate": 1.0},
+                                        "long": {"burn_rate": 1.0}},
+             "budget_remaining": 0.9},
+        ])
+        hub = qt.TelemetryHub(watches=())
+        assert hub.ingest_jsonl(p) == 2
+        assert hub.ingest_jsonl(p) == 0           # nothing new
+        assert len(hub.series["serve_queue_depth"]) == 1
+        assert len(hub.series["slo_burn_short"]) == 1
+        assert hub.counters()[qm.HOT_ROWS] == 40
+        # the file GROWS: only the tail folds
+        self._write(p, [
+            {"kind": "serving", "counters": {"hot_rows": 70},
+             "serving": {"queue_depth": 5, "shed_level": 0,
+                         "mean_batch_fill": 7.0}},
+        ])
+        assert hub.ingest_jsonl(p) == 1
+        assert len(hub.series["serve_queue_depth"]) == 2
+        assert hub.counters()[qm.HOT_ROWS] == 70  # cumulative diff
+
+    def test_masked_rollover_still_folds_the_new_tail(self, tmp_path):
+        # a second rollover can DROP d old records while appending >= d
+        # new ones between polls: the visible count never shrinks, so a
+        # count-only high-water mark would silently skip the genuinely
+        # new tail — the first-record fingerprint catches the changed
+        # prefix and triggers the re-fold
+        p = str(tmp_path / "r.jsonl")
+        old = [{"kind": "serving", "counters": {"hot_rows": 10 * i},
+                "serving": {"queue_depth": i, "shed_level": 0,
+                            "mean_batch_fill": 1.0}}
+               for i in range(1, 4)]
+        self._write(p + ".1", old[:2])
+        self._write(p, old[2:])
+        hub = qt.TelemetryHub(watches=())
+        assert hub.ingest_jsonl(p) == 3
+        # second rollover: the oldest two records vanish, three new
+        # ones appear — same total count growth as pure appends
+        new = [{"kind": "serving", "counters": {"hot_rows": 10 * i},
+                "serving": {"queue_depth": i, "shed_level": 0,
+                            "mean_batch_fill": 1.0}}
+               for i in range(4, 7)]
+        os.replace(p, p + ".1")            # old[2:] -> the .1 half
+        self._write(p, new)
+        assert hub.ingest_jsonl(p) > 0, \
+            "masked rollover: new records were silently skipped"
+        # the newest gauge point made it into the series
+        assert hub.series["serve_queue_depth"].last() == 6.0
+        # counters stay exact either way (the cumulative diff)
+        assert hub.counters()[qm.HOT_ROWS] == 60
+
+    def test_interleaved_kinds_diff_independently(self, tmp_path):
+        # step_stats and serving counters are two independent
+        # cumulative streams (two StepStats objects) in one file — the
+        # per-(source, kind) diff keys must keep them apart
+        p = str(tmp_path / "r.jsonl")
+        self._write(p, [
+            {"kind": "step_stats", "counters": {"hot_rows": 100}},
+            {"kind": "serving", "counters": {"hot_rows": 10},
+             "serving": {"queue_depth": 0, "shed_level": 0,
+                         "mean_batch_fill": 1.0}},
+            {"kind": "step_stats", "counters": {"hot_rows": 150}},
+            {"kind": "serving", "counters": {"hot_rows": 30},
+             "serving": {"queue_depth": 0, "shed_level": 0,
+                         "mean_batch_fill": 1.0}},
+        ])
+        hub = qt.TelemetryHub(watches=())
+        hub.ingest_jsonl(p)
+        # 150 from the step stream + 30 from the serve stream; a
+        # shared diff key would have produced wild deltas
+        assert hub.counters()[qm.HOT_ROWS] == 180
+
+
+# ---------------------------------------------------------------------------
+# 4. the health formula
+# ---------------------------------------------------------------------------
+
+
+class TestHealthScore:
+    def test_healthy_is_one(self):
+        score, comp = qf.health_score(burn=0.5, shed_frac=0.0)
+        assert score == 1.0 and not comp["stale"]
+
+    def test_sustainable_burn_is_free(self):
+        assert qf.health_score(burn=1.0)[0] == 1.0
+
+    def test_burn_past_one_costs_linearly(self):
+        assert qf.health_score(burn=1.5)[0] == pytest.approx(0.75)
+        assert qf.health_score(burn=2.0)[0] == pytest.approx(0.5)
+        assert qf.health_score(burn=50.0)[0] == pytest.approx(0.5)
+
+    def test_shed_costs_up_to_half(self):
+        assert qf.health_score(shed_frac=0.5)[0] == pytest.approx(0.75)
+        assert qf.health_score(shed_frac=1.0)[0] == pytest.approx(0.5)
+
+    def test_both_floor_at_zero(self):
+        assert qf.health_score(burn=3.0, shed_frac=1.0)[0] == 0.0
+
+    def test_stale_is_zero_regardless(self):
+        score, comp = qf.health_score(burn=0.0, shed_frac=0.0,
+                                      stale=True, age_s=9.0)
+        assert score == 0.0
+        assert comp["stale"] and comp["age_s"] == 9.0
+
+    def test_no_burn_signal_reads_as_sustainable(self):
+        assert qf.health_score(burn=None)[0] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# 5. multi-process aggregation (the tier-1 fleet smoke)
+# ---------------------------------------------------------------------------
+
+# the emitter subprocesses are stdlib-only (no jax import — each would
+# cost seconds of tier-1 budget): every process writes its own sink,
+# meta header first, exactly like a MetricsSink would
+_EMITTER = r"""
+import json, os, sys
+path, mode = sys.argv[1], sys.argv[2]
+
+def w(f, rec):
+    f.write(json.dumps(rec) + "\n")
+
+def meta(f, replica):
+    w(f, {"ts": 0.0, "kind": "meta", "host": "test-host",
+          "pid": os.getpid(), "start_ts": 0.0, "replica": replica})
+
+def step(hot, cold, peak):
+    return {"ts": 0.0, "kind": "step_stats",
+            "counters": {"hot_rows": hot, "cold_rows": cold,
+                         "exchange_bucket_max": peak},
+            "wall": {"p50_ms": 2.0}}
+
+if mode == "plain":            # healthy replica: 3 cumulative snaps
+    with open(path, "w") as f:
+        meta(f, "r0")
+        w(f, step(10, 5, 3))
+        w(f, step(20, 10, 4))
+        w(f, step(30, 15, 4))
+        w(f, {"ts": 0.0, "kind": "slo",
+              "windows": {"short": {"burn_rate": 1.5},
+                          "long": {"burn_rate": 1.25}},
+              "budget_remaining": 0.2})
+elif mode == "seam":           # history crosses a rollover seam
+    with open(path + ".1", "w") as f:
+        meta(f, "r1")
+        w(f, step(40, 20, 9))
+    with open(path, "w") as f:
+        meta(f, "r1")
+        w(f, step(100, 50, 9))
+        w(f, {"ts": 0.0, "kind": "serving",
+              "counters": {"hot_rows": 1},
+              "request": {"p99_ms": 30.0},
+              "serving": {"queue_depth": 2, "shed_level": 1,
+                          "mean_batch_fill": 4.0,
+                          "fanout_variants": [[4, 4], [2, 2],
+                                              [1, 1]]}})
+elif mode == "silent":         # emits once, then never again
+    with open(path, "w") as f:
+        meta(f, "r2")
+        w(f, step(7, 3, 1))
+"""
+
+
+def _spawn_emitters(tmp_path):
+    paths = {"r0": str(tmp_path / "r0.jsonl"),
+             "r1": str(tmp_path / "r1.jsonl"),
+             "r2": str(tmp_path / "r2.jsonl")}
+    procs = [subprocess.Popen([sys.executable, "-c", _EMITTER,
+                               paths[n], mode])
+             for n, mode in (("r0", "plain"), ("r1", "seam"),
+                             ("r2", "silent"))]
+    pids = [p.pid for p in procs]
+    for p in procs:
+        assert p.wait(timeout=30) == 0
+    return paths, pids
+
+
+class TestFleetAggregator:
+    def test_three_process_merge_and_staleness(self, tmp_path):
+        paths, pids = _spawn_emitters(tmp_path)
+        fake = [0.0]
+        sink_path = str(tmp_path / "fleet.jsonl")
+        sink = qm.MetricsSink(sink_path)
+        agg = qf.FleetAggregator(paths, interval_s=1.0,
+                                 stale_after_s=3.0, sink=sink,
+                                 clock=lambda: fake[0])
+        snap = agg.poll()
+        # every replica healthy and attributed to its REAL writer pid
+        assert snap["fleet"]["status"] in ("ok", "degraded")
+        for name, pid in zip(("r0", "r1", "r2"), pids):
+            r = snap["replicas"][name]
+            assert not r["stale"]
+            assert r["meta"]["pid"] == pid
+            assert r["meta"]["host"] == "test-host"
+        # r1's full seam history folded: counters are cumulative per
+        # source, so its final truth is the NEWEST snapshot (100), not
+        # the sum of snapshots
+        assert agg.replica_hub("r1").counters()[qm.HOT_ROWS] == 101
+        # r0's burn (1.5 short) costs 0.25; r1 sheds 1 of 2 ladder
+        # steps (0.25) — the formula, applied to observed series
+        assert snap["replicas"]["r0"]["health"] == pytest.approx(0.75)
+        assert snap["replicas"]["r1"]["health"] == pytest.approx(0.75)
+        assert snap["replicas"]["r2"]["health"] == 1.0
+        # fleet-global counters match the hand-folded truth:
+        # add slots sum the per-replica cumulative finals, max slots
+        # take the max (30+101+7, max(4, 9, 1))
+        fleet_c = agg.fleet.counters()
+        assert fleet_c[qm.HOT_ROWS] == 30 + 101 + 7
+        assert fleet_c[qm.EXCH_BUCKET_MAX] == 9
+        # r0 keeps emitting, r2 goes silent: advance past stale_after
+        with open(paths["r0"], "a") as f:
+            f.write(json.dumps(
+                {"ts": 0.0, "kind": "step_stats",
+                 "counters": {"hot_rows": 35, "cold_rows": 15,
+                              "exchange_bucket_max": 4}}) + "\n")
+        fake[0] = 3.5
+        snap2 = agg.poll()             # ONE aggregation interval later
+        assert not snap2["replicas"]["r0"]["stale"]
+        assert snap2["replicas"]["r2"]["stale"]
+        assert snap2["replicas"]["r2"]["health"] == 0.0
+        assert snap2["fleet"]["status"] == "degraded"
+        stale_anoms = [a for a in agg.anomalies
+                       if a["detector"] == "staleness"]
+        assert [a["replica"] for a in stale_anoms] == ["r1", "r2"]
+        agg.close()
+        sink.close()
+        # the verdict stream: fleet records + the staleness anomaly
+        recs = qm.read_jsonl(sink_path)
+        kinds = [r["kind"] for r in recs]
+        assert kinds.count("fleet") == 2
+        assert "anomaly" in kinds
+        fleet_rec = [r for r in recs if r["kind"] == "fleet"][-1]
+        assert fleet_rec["replicas"]["r2"]["stale"] is True
+
+    def test_recovery_clears_staleness(self, tmp_path):
+        p = str(tmp_path / "r.jsonl")
+        open(p, "w").write(json.dumps(
+            {"kind": "step_stats", "counters": {"hot_rows": 1}}) + "\n")
+        fake = [0.0]
+        agg = qf.FleetAggregator([p], interval_s=1.0, stale_after_s=2.0,
+                                 clock=lambda: fake[0])
+        agg.poll()
+        fake[0] = 5.0
+        assert agg.poll()["replicas"]["r0"]["stale"]
+        with open(p, "a") as f:
+            f.write(json.dumps({"kind": "step_stats",
+                                "counters": {"hot_rows": 2}}) + "\n")
+        snap = agg.poll()
+        assert not snap["replicas"]["r0"]["stale"]
+        assert snap["replicas"]["r0"]["health"] == 1.0
+        agg.close()
+
+    def test_path_list_and_validation(self, tmp_path):
+        p = str(tmp_path / "a.jsonl")
+        open(p, "w").close()
+        agg = qf.FleetAggregator([p])
+        assert agg.replica_names == ["r0"]
+        agg.close()
+        with pytest.raises(ValueError):
+            qf.FleetAggregator({})
+        with pytest.raises(ValueError):
+            qf.FleetAggregator([])
+
+    def test_background_thread_polls_and_close_reaps(self, tmp_path):
+        p = str(tmp_path / "a.jsonl")
+        open(p, "w").write(json.dumps(
+            {"kind": "step_stats", "counters": {"hot_rows": 1}}) + "\n")
+        agg = qf.FleetAggregator([p], interval_s=0.05)
+        agg.start()
+        deadline = time.monotonic() + 10.0
+        while agg.polls == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert agg.polls > 0
+        agg.close()
+        agg.close()                               # idempotent
+        assert not any(t.name == "qt-fleet-agg" and t.is_alive()
+                       for t in __import__("threading").enumerate())
+
+
+# ---------------------------------------------------------------------------
+# 6. the export endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestExportPlane:
+    @pytest.fixture
+    def plane(self, tmp_path):
+        paths, _pids = _spawn_emitters(tmp_path)
+        fake = [0.0]
+        agg = qf.FleetAggregator(paths, interval_s=1.0,
+                                 stale_after_s=3.0,
+                                 clock=lambda: fake[0])
+        exp = qf.FleetExporter(agg, port=0)
+        yield agg, exp, fake
+        exp.close()
+        agg.close()
+
+    def _get(self, exp, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}{path}", timeout=10)
+
+    def test_metrics_is_valid_exposition(self, plane):
+        agg, exp, _ = plane
+        body = self._get(exp, "/metrics").read().decode()
+        qt_agg = _load_script("qt_agg")
+        assert qt_agg.check_exposition(body) == []
+        for needle in (
+                'qt_replica_health{replica="r0"}',
+                'qt_replica_health{replica="r1"}',
+                'qt_replica_health{replica="r2"}',
+                'qt_replica_stale{replica="r2"} 0',
+                "qt_fleet_replicas 3",
+                # per-replica AND fleet-global series + counters
+                'qt_series{replica="r0",name="hot_hit_rate"}',
+                'qt_series{name="hot_hit_rate"}',
+                'qt_counter_total{replica="r1",name="hot_rows"} 101',
+                'qt_counter_total{name="hot_rows"} 138',
+                'qt_series{replica="r1",name="serve_request_p99_ms"} '
+                '30',
+                'qt_series{replica="r0",name="slo_burn_short"} 1.5'):
+            assert needle in body, f"/metrics missing {needle}"
+
+    def test_healthz_verdict_and_codes(self, plane):
+        agg, exp, fake = plane
+        with self._get(exp, "/healthz") as h:
+            assert h.status == 200
+            doc = json.loads(h.read())
+        assert doc["fleet"]["status"] == "ok"
+        assert set(doc["replicas"]) == {"r0", "r1", "r2"}
+        # the whole fleet goes silent -> down -> 503
+        fake[0] = 10.0
+        agg.poll()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(exp, "/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["fleet"]["status"] == "down"
+
+    def test_unknown_path_404(self, plane):
+        _, exp, _ = plane
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(exp, "/nope")
+        assert e.value.code == 404
+
+    def test_scrape_polls_when_not_running(self, plane):
+        agg, exp, _ = plane
+        before = agg.polls
+        self._get(exp, "/metrics").read()
+        assert agg.polls == before + 1
+
+    def test_never_started_exporter_closes_without_hanging(
+            self, tmp_path):
+        # stdlib shutdown() blocks on an event only serve_forever sets
+        # — closing a never-started exporter must not wait on it
+        p = str(tmp_path / "a.jsonl")
+        open(p, "w").close()
+        agg = qf.FleetAggregator([p])
+        exp = qf.FleetExporter(agg, port=0, start=False)
+        done = []
+        t = __import__("threading").Thread(
+            target=lambda: (exp.close(), done.append(True)))
+        t.start()
+        t.join(timeout=5.0)
+        assert done, "close() hung on a never-started server"
+        agg.close()
+
+    def test_label_escaping(self, tmp_path):
+        p = str(tmp_path / "a.jsonl")
+        open(p, "w").write(json.dumps(
+            {"kind": "step_stats", "counters": {"hot_rows": 1}}) + "\n")
+        agg = qf.FleetAggregator({'we"ird\\name': p})
+        agg.poll()
+        body = qf.prometheus_text(agg)
+        assert r'replica="we\"ird\\name"' in body
+        qt_agg = _load_script("qt_agg")
+        assert qt_agg.check_exposition(body) == []
+        agg.close()
+
+
+# ---------------------------------------------------------------------------
+# 7. the end-to-end demo: live replicas, one killed mid-load
+# ---------------------------------------------------------------------------
+
+# a LIVE emitter: appends one cumulative snapshot every 50 ms until
+# killed (stdlib-only, same reasoning as _EMITTER)
+_LIVE_EMITTER = r"""
+import json, os, sys, time
+path, replica = sys.argv[1], sys.argv[2]
+with open(path, "w", buffering=1) as f:
+    f.write(json.dumps({"ts": 0.0, "kind": "meta", "host": "live",
+                        "pid": os.getpid(), "start_ts": 0.0,
+                        "replica": replica}) + "\n")
+    hot = 0
+    while True:
+        hot += 10
+        f.write(json.dumps({"ts": 0.0, "kind": "step_stats",
+                            "counters": {"hot_rows": hot}}) + "\n")
+        time.sleep(0.05)
+"""
+
+
+class TestFleetDemoLive:
+    def test_kill_replica_degrades_health_within_one_interval(
+            self, tmp_path):
+        paths = {f"r{i}": str(tmp_path / f"r{i}.jsonl")
+                 for i in range(3)}
+        procs = {n: subprocess.Popen(
+            [sys.executable, "-c", _LIVE_EMITTER, p, n])
+            for n, p in paths.items()}
+        agg = qf.FleetAggregator(paths, interval_s=0.2,
+                                 stale_after_s=0.6)
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                snap = agg.poll()
+                if (snap["fleet"]["status"] == "ok"
+                        and all(v["records"] > 1
+                                for v in snap["replicas"].values())):
+                    break
+                time.sleep(0.1)
+            assert snap["fleet"]["status"] == "ok", snap
+            # kill r1 mid-load; the survivors keep emitting
+            procs["r1"].send_signal(signal.SIGKILL)
+            procs["r1"].wait(timeout=10)
+            t_kill = time.monotonic()
+            deadline = t_kill + 20.0
+            while time.monotonic() < deadline:
+                time.sleep(0.2)
+                snap = agg.poll()
+                if snap["replicas"]["r1"]["stale"]:
+                    break
+            lag = time.monotonic() - t_kill
+            assert snap["replicas"]["r1"]["stale"], \
+                f"silent replica never flagged: {snap}"
+            assert snap["replicas"]["r1"]["health"] == 0.0
+            assert snap["fleet"]["status"] == "degraded"
+            assert not snap["replicas"]["r0"]["stale"]
+            assert not snap["replicas"]["r2"]["stale"]
+            assert any(a["detector"] == "staleness"
+                       and a["replica"] == "r1"
+                       for a in agg.anomalies)
+            # "within one aggregation interval" of the staleness
+            # horizon — generous absolute bound for a loaded CI box
+            assert lag < 0.6 + 5 * 0.2 + 2.0, \
+                f"staleness detection lagged {lag:.1f}s"
+        finally:
+            agg.close()
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# 8. the CLIs
+# ---------------------------------------------------------------------------
+
+
+class TestQtAggCli:
+    def test_smoke_mode_passes(self, tmp_path, capsys):
+        qt_agg = _load_script("qt_agg")
+        out = str(tmp_path / "fleet.jsonl")
+        rc = qt_agg.main(["--smoke", "--no-color", "--jsonl", out])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "status ok" in text and "format OK" in text
+        kinds = [r["kind"] for r in qm.read_jsonl(out)]
+        assert "fleet" in kinds and "meta" in kinds
+
+    def test_once_mode(self, tmp_path, capsys):
+        p = str(tmp_path / "r.jsonl")
+        open(p, "w").write(json.dumps(
+            {"kind": "step_stats", "counters": {"hot_rows": 5}}) + "\n")
+        qt_agg = _load_script("qt_agg")
+        rc = qt_agg.main(["--once", "--no-color",
+                          "--replicas", f"serve-a={p}", "--jsonl", ""])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "serve-a: health 1.00" in out
+
+    def test_replica_spec_parsing(self):
+        qt_agg = _load_script("qt_agg")
+        assert qt_agg._parse_replicas("a=/x,b=/y") == {"a": "/x",
+                                                      "b": "/y"}
+        assert qt_agg._parse_replicas("/x,/y") == {"r0": "/x",
+                                                   "r1": "/y"}
+        with pytest.raises(SystemExit):
+            qt_agg._parse_replicas("a=/x,a=/y")
+        with pytest.raises(SystemExit):
+            qt_agg._parse_replicas("")
+
+
+class TestQtTopFleet:
+    SCRIPT = os.path.join(REPO, "scripts", "qt_top.py")
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, self.SCRIPT, "--once", "--no-color",
+             *args],
+            capture_output=True, text=True, timeout=60)
+
+    def _fleet_file(self, tmp_path):
+        p = tmp_path / "fleet.jsonl"
+        recs = [{"kind": "fleet",
+                 "replicas": {
+                     "r0": {"health": 1.0 - 0.1 * i, "stale": False,
+                            "age_s": 0.1, "records": 5 + i,
+                            "components": {"burn": 0.5 + 0.2 * i,
+                                           "shed_frac": 0.0}},
+                     "r1": {"health": 0.0, "stale": True,
+                            "age_s": 9.9, "records": 2,
+                            "components": {"burn": None,
+                                           "shed_frac": 0.0}}},
+                 "fleet": {"status": "degraded", "replica_count": 2,
+                           "stale_count": 1, "health_min": 0.0,
+                           "health_mean": 0.45 - 0.05 * i,
+                           "polls": i + 1}}
+                for i in range(3)]
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        return str(p)
+
+    def test_fleet_panel_renders(self, tmp_path):
+        p = self._fleet_file(tmp_path)
+        r = self._run("--jsonl", p, "--fleet")
+        assert r.returncode == 0
+        out = r.stdout
+        assert "status degraded" in out
+        assert "r1" in out and "STALE" in out
+        assert "health 0.8" in out            # the newest r0 score
+
+    def test_fleet_records_render_in_default_view(self, tmp_path):
+        p = self._fleet_file(tmp_path)
+        r = self._run("--jsonl", p)
+        assert r.returncode == 0
+        assert "health:r0" in r.stdout        # the health trend series
+        assert "STALE" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# 9. serving health hook
+# ---------------------------------------------------------------------------
+
+
+class TestServingHealthHook:
+    def test_snapshot_carries_health(self):
+        # the hook itself is formula plumbing — pin it without a jax
+        # engine via a minimal stand-in
+        class FakeEngine:
+            variants = [[4, 4], [2, 2]]
+        from quiver_tpu.serving import MicroBatchServer
+        srv = MicroBatchServer.__new__(MicroBatchServer)
+        srv.engine = FakeEngine()
+        srv.slo = None
+        srv._shed_level = 1
+        h = srv.health()
+        assert h["score"] == pytest.approx(0.5)   # full shed, 1-step
+        assert h["components"]["shed_frac"] == 1.0

@@ -20,6 +20,7 @@ The contract under test, in order of importance:
 """
 
 import json
+import os
 
 import numpy as np
 import jax
@@ -449,14 +450,16 @@ class TestMetricsSink:
                        "arr": np.arange(2)})     # numpy-safe encoding
         with open(path) as f:
             recs = [json.loads(l) for l in f if l.strip()]
-        assert len(recs) == 3
+        assert len(recs) == 4
         for r in recs:
             assert isinstance(r["ts"], float) and "kind" in r
-        assert recs[0]["kind"] == "step_stats"
-        assert recs[0]["counters"]["frontier_valid"] == 30
-        assert recs[0]["derived"]["frontier_fill"] == pytest.approx(0.75)
-        assert recs[1]["kind"] == "canary" and recs[1]["usable"] is True
-        assert recs[2]["arr"] == [0, 1]
+        # the sink self-attributes: one meta header precedes the data
+        assert recs[0]["kind"] == "meta" and recs[0]["pid"] == os.getpid()
+        assert recs[1]["kind"] == "step_stats"
+        assert recs[1]["counters"]["frontier_valid"] == 30
+        assert recs[1]["derived"]["frontier_fill"] == pytest.approx(0.75)
+        assert recs[2]["kind"] == "canary" and recs[2]["usable"] is True
+        assert recs[3]["arr"] == [0, 1]
 
 
 class TestGatherCollectorPlumbing:
@@ -516,6 +519,7 @@ class TestServingTelemetry:
             sink.emit_stats(stats)                    # default unchanged
         with open(path) as f:
             recs = [json.loads(l) for l in f if l.strip()]
+        recs = [r for r in recs if r["kind"] != "meta"]  # sink header
         assert recs[0]["kind"] == "serving"
         assert recs[0]["request"]["count"] == 1
         assert recs[0]["serving"]["requests"] == 1

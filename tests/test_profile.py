@@ -200,6 +200,7 @@ class TestStageProfiler:
             prof.add_group(_group())
             prof.run()
         recs = [json.loads(l) for l in open(path) if l.strip()]
+        recs = [r for r in recs if r["kind"] != "meta"]  # sink header
         assert recs and all(r["kind"] == "profile" for r in recs)
         assert recs[-1]["entry"] == "prof_test"
 
@@ -350,7 +351,8 @@ class TestQtProfCli:
         assert "lookup_tiered" in out and "machine probe" in out
         recs = [json.loads(l) for l in open(path) if l.strip()]
         kinds = {r["kind"] for r in recs}
-        assert kinds == {"profile"}
+        assert kinds == {"meta", "profile"}    # meta = the sink header
+        recs = [r for r in recs if r["kind"] == "profile"]
         by_entry = {r["entry"]: r for r in recs}
         assert "__machine__" in by_entry and "lookup_tiered" in by_entry
         st = by_entry["lookup_tiered"]["stages"][0]

@@ -269,8 +269,10 @@ class TestOverloadAndShedding:
         with qm.MetricsSink(str(path)) as sink:
             rec = srv.emit(sink)
         assert rec["kind"] == "serving"
-        got = json.loads(path.read_text().strip())
-        assert got["kind"] == "serving"
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        # the sink self-attributes: meta header first, then the record
+        assert [l["kind"] for l in lines] == ["meta", "serving"]
+        got = lines[1]
         assert got["request"]["count"] == 12          # per-REQUEST p99
         assert got["request"]["p99_ms"] > 0
         assert got["serving"]["requests"] == 12
@@ -372,6 +374,57 @@ class TestTracingAndSlo:
             assert coa[2] + coa[3] <= req[2] + req[3] + eps
             assert req[2] + req[3] >= dispatch_t0[req[5]["batch"]] - eps
 
+    def test_injected_context_propagates_to_replica_trace(
+            self, engine, traced, tmp_path):
+        # the fleet acceptance pin: a trace context injected
+        # CLIENT-side (tracing.inject into request metadata) reappears
+        # under the same trace_id in the replica's exported trace —
+        # the cross-process correlation the merged Perfetto view
+        # pivots on. The injected id is pid-prefixed (globally
+        # unique), so it can't collide with locally minted ids.
+        ctx = tracing.inject({"app_field": "kept"},
+                             replica="client-7")
+        client_tid = ctx[tracing.CTX_TRACE_ID]
+        assert tracing.extract(ctx).replica == "client-7"
+        srv = qv.MicroBatchServer(
+            engine, qv.ServeConfig(max_wait_ms=1.0, queue_depth=32,
+                                   shed_queue_frac=1.0))
+        with srv:
+            fut = srv.submit(3, context=ctx)
+            plain = srv.submit(4)            # no context: local id
+            fut.result(timeout=20)
+            plain.result(timeout=20)
+        recs = traced.records()
+        req_ids = {r[4] for r in recs if r[0] == "serve.request"}
+        assert client_tid in req_ids
+        # the full request span set carries the propagated id
+        names_with_ctx = {r[0] for r in recs if r[4] == client_tid}
+        assert {"serve.request", "serve.admission_wait",
+                "serve.coalesce_wait"} <= names_with_ctx
+        # and it survives into the exported trace's span args under a
+        # replica-labeled process track
+        out = str(tmp_path / "replica_trace.json")
+        traced.export_chrome_trace(out, replica="serve-replica-0")
+        doc = json.load(open(out))
+        hits = [e for e in doc["traceEvents"]
+                if (e.get("args") or {}).get("trace_id") == client_tid]
+        assert any(e["name"] == "serve.request" for e in hits)
+        procs = [e for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert procs[0]["args"]["name"] == "serve-replica-0"
+
+    def test_garbled_context_falls_back_to_local_id(self, engine,
+                                                    traced):
+        srv = qv.MicroBatchServer(
+            engine, qv.ServeConfig(max_wait_ms=1.0, queue_depth=32,
+                                   shed_queue_frac=1.0))
+        with srv:
+            srv.submit(5, context={"qt.trace_id": "garbage"}) \
+               .result(timeout=20)
+        reqs = [r for r in traced.records()
+                if r[0] == "serve.request"]
+        assert reqs and all(r[4] is not None for r in reqs)
+
     def test_slo_burn_rate_sheds_quality(self, engine):
         # a sub-ms p99 target makes every CPU request "bad": the short
         # window burns at ~1/budget >> shed_burn_rate once min samples
@@ -404,7 +457,8 @@ class TestTracingAndSlo:
             srv.slo.emit(sink)                        # kind slo
         assert rec["slo"]["target_p99_ms"] == 5000.0
         lines = [json.loads(l) for l in path.read_text().splitlines()]
-        assert [l["kind"] for l in lines] == ["serving", "slo"]
+        assert [l["kind"] for l in lines] == ["meta", "serving", "slo"]
+        lines = lines[1:]                 # past the sink's meta header
         assert lines[0]["slo"]["total"]["requests"] == 25
         assert lines[1]["target_p99_ms"] == 5000.0
         assert "burn_rate" in lines[1]["windows"]["short"]

@@ -265,10 +265,10 @@ class TestSinkRotation:
             sink.emit({"i": i, "pad": "x" * 40}, kind="record")
         sink.close()
         assert os.path.exists(path + ".1"), "never rolled over"
-        assert os.path.getsize(path) < 20 * 60, "rotation did not bound"
+        assert os.path.getsize(path) < 22 * 60, "rotation did not bound"
         recs = qm.read_jsonl(path)
-        assert 0 < len(recs) < 20           # one backup level: bounded
-        idx = [r["i"] for r in recs]
+        assert 0 < len(recs) < 22           # one backup level: bounded
+        idx = [r["i"] for r in recs if r["kind"] == "record"]
         assert idx == sorted(idx)           # seam read is chronological
         assert idx[-1] == 19                # newest record never lost
 
@@ -278,7 +278,8 @@ class TestSinkRotation:
             for i in range(5):
                 sink.emit({"i": i})
         assert not os.path.exists(path + ".1")
-        assert [r["i"] for r in qm.read_jsonl(path)] == list(range(5))
+        assert [r["i"] for r in qm.read_jsonl(path)
+                if r["kind"] == "record"] == list(range(5))
 
 
 def _degraded_run(tmp_path, rng):

@@ -201,8 +201,9 @@ class TestSloBudget:
         with MetricsSink(str(path)) as sink:
             rec = b.emit(sink)
         assert rec["kind"] == "slo"
-        got = json.loads(path.read_text().strip())
-        assert got["kind"] == "slo"
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [l["kind"] for l in lines] == ["meta", "slo"]
+        got = lines[1]                    # past the sink's meta header
         assert got["target_p99_ms"] == 10.0
         assert got["windows"]["short"]["requests"] == 31
         assert got["windows"]["short"]["bad"] == 1
@@ -229,8 +230,9 @@ class TestScopeTimer:
         with MetricsSink(str(path)) as sink:
             rec = t.emit(sink)
         assert rec["kind"] == "scope_timer"
-        got = json.loads(path.read_text().strip())
-        assert got["kind"] == "scope_timer"
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [l["kind"] for l in lines] == ["meta", "scope_timer"]
+        got = lines[1]                    # past the sink's meta header
         assert got["scopes"]["stage_b"]["calls"] == 1
 
     def test_measure_feeds_spans_when_tracing(self, global_tracing):
