@@ -32,6 +32,24 @@ delay is REAL — a closed-loop driver would hide it):
    replica sink + a live 4 Hz ``FleetAggregator`` + one real
    ``/metrics`` scrape), arms interleaved per rep — throughput with
    the plane on must be within noise of off.
+5. **Failure degrades in a PLANNED way**: the ``chaos_ab`` block runs
+   the same sustained-rate load against two fresh 3-replica fleets —
+   one clean, one whose victim replica carries a seeded ``FaultPlan``
+   (``rpc.request:kill,after=N`` — the replica SIGKILLs itself
+   mid-load, deterministically by request count, not wall clock) —
+   each fleet under a ``ReplicaSupervisor`` (restart w/ backoff +
+   crash-loop breaker), a ``FleetAggregator`` + ``HealthRouter``
+   (staleness detection -> drain -> re-admit), and the retrying/
+   hedging ``RpcClient``. Recorded: ``chaos_accepted_p99_ratio``
+   (chaos p99 / clean p99), ``chaos_error_rate`` (typed errors /
+   requests — every future resolves, nothing silently lost),
+   ``chaos_detection_s`` (supervisor-logged exit -> aggregator
+   staleness anomaly) and ``chaos_recovery_s`` (exit -> the restarted
+   replica answering again) — all tracked as LOWER-is-better
+   trajectory groups by ``bench_regress.py``. ``--chaos-only`` runs
+   just this block against real serve replicas (the chip_suite
+   ``chaos`` section); in ``--smoke`` the replicas are jax-free fake
+   backends (the harness + JSON contract, not a comparable number).
 
 Also sweeps ``batch_cap`` x ``max_wait_ms`` at a fixed offered load —
 the coalescing-deadline tradeoff surface (bigger batches amortize
@@ -61,8 +79,14 @@ import numpy as np
 from benchmarks._common import configure_jax
 
 METRIC = "served requests/sec at p99 budget (coalesced micro-batch)"
+CHAOS_METRIC = ("accepted requests/sec under a seeded replica kill "
+                "(3-replica fleet, supervisor + router + rpc client)")
 FULL = [10, 5]
 SHED_LADDER = [[10, 5], [4, 2], [2, 1]]
+
+#: the chaos arm's seeded trigger: the victim replica SIGKILLs itself
+#: after serving this many RPC requests (deterministic by count)
+CHAOS_KILL_AFTER = 40
 
 
 def _record(value=None, err=None, skipped=False, **extra):
@@ -353,6 +377,289 @@ def fleet_plane_ab(qv, engine, cfg, rate, trial_s, n_nodes, best_of,
     }
 
 
+# -- chaos: replica entry point + the kill A/B -------------------------------
+
+
+def fake_row(node: int):
+    """The deterministic row the FAKE replicas serve (verified
+    end-to-end by the chaos load loop in smoke mode)."""
+    return np.array([node, node * 0.5, node % 7], np.float32)
+
+
+def run_replica(a) -> int:
+    """``--replica`` mode: this script IS one serve replica. Fake
+    (``--replica-fake``): a jax-free deterministic backend behind the
+    RPC front end (loads ``quiver_tpu/rpc.py`` through a synthetic
+    package — boots in ~300 ms); real: the same serving world as the
+    parent (same seeds) behind ``MicroBatchServer`` + ``RpcServer``.
+    Either way the replica heartbeats its sink until killed; a
+    ``FaultPlan`` arrives via ``QT_FAULTS`` in the environment."""
+    import json as _json
+    if a.replica_fake:
+        import importlib
+        import types
+        pkg_name = "_qt_bench_rpc"
+        pkg = types.ModuleType(pkg_name)
+        pkg.__path__ = [os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "quiver_tpu")]
+        sys.modules[pkg_name] = pkg
+        rpc = importlib.import_module(pkg_name + ".rpc")
+        import concurrent.futures as cf
+
+        class Backend:
+            def submit(self, node, context=None, deadline=None):
+                fut = cf.Future()
+                fut.set_result(fake_row(node))
+                return fut
+
+            def health(self):
+                return {"score": 1.0}
+
+        rpc.RpcServer(Backend(), port=a.port)
+        with open(a.replica_sink, "a", buffering=1) as f:
+            f.write(_json.dumps({
+                "ts": time.time(), "kind": "meta", "host": "fake",
+                "pid": os.getpid(), "start_ts": time.time(),
+                "replica": a.replica_name}) + "\n")
+            beats = 0
+            while True:
+                beats += 1
+                f.write(_json.dumps(
+                    {"ts": time.time(), "kind": "step_stats",
+                     "counters": {"hot_rows": beats}}) + "\n")
+                time.sleep(0.05)
+    jax = configure_jax()
+    import quiver_tpu as qv
+    from quiver_tpu import rpc as qrpc
+    from quiver_tpu.metrics import MetricsSink
+
+    class W:
+        pass
+
+    w = W()
+    w.nodes = int(os.environ.get("QT_SERVE_NODES", 50_000))
+    w.dim = int(os.environ.get("QT_SERVE_DIM", 32))
+    w.hidden, w.classes, w.avg_deg = 16, 8, 8
+    engine_of, _n = build_world(w, jax)
+    engine = engine_of([FULL],
+                       int(os.environ.get("QT_SERVE_BATCH_CAP", 32)))
+    srv = qv.MicroBatchServer(engine, qv.ServeConfig(
+        max_wait_ms=2.0, slo_p99_ms=a.budget_ms))
+    qrpc.RpcServer(srv, port=a.port)
+    sink = MetricsSink(a.replica_sink, replica=a.replica_name)
+    while True:
+        srv.emit(sink)                  # the heartbeat the fleet
+        time.sleep(0.1)                 # aggregator judges staleness by
+
+
+def _free_ports(k):
+    import socket
+    socks = [socket.socket() for _ in range(k)]
+    try:
+        for s in socks:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def chaos_ab(smoke: bool, budget_ms: float, rate_rps: float = None,
+             trial_s: float = None):
+    """Sustained-rate load vs the same fleet shape with a seeded
+    kill-and-restart plan (see module doc §5). Two FRESH fleets (the
+    clean arm must not inherit a victim already past its trigger);
+    the chaos arm arms r0's FIRST life with the seeded kill rule —
+    survivors (full mode) carry a low-rate sink-write fault plan, so
+    the telemetry-resilience path runs under real load too."""
+    import quiver_tpu as qv
+    from quiver_tpu import fleet as qfleet
+    from quiver_tpu import rpc as qrpc
+    from quiver_tpu.metrics import MetricsSink, read_jsonl
+
+    import subprocess
+    import tempfile
+
+    names = ["r0", "r1", "r2"]
+    rate_rps = rate_rps or (120.0 if smoke else 150.0)
+    trial_s = trial_s or (2.5 if smoke else 6.0)
+    n_req = max(int(rate_rps * trial_s), 30)
+    kill_plan = qv.FaultPlan(seed=7, rules={
+        "rpc.request": qv.FaultRule("kill", after=CHAOS_KILL_AFTER)})
+    bg_plan = qv.FaultPlan(seed=11, rules={
+        "sink.write": qv.FaultRule("error", errno_name="EIO",
+                                   rate=0.05)})
+
+    def run_arm(armed: bool) -> dict:
+        d = tempfile.mkdtemp(prefix="qt_chaos_")
+        ports = dict(zip(names, _free_ports(3)))
+        sinks = {n: os.path.join(d, f"{n}.jsonl") for n in names}
+        ev_path = os.path.join(d, "events.jsonl")
+        ev_sink = MetricsSink(ev_path)
+
+        def spawn(name, index, attempt):
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("QT_FAULTS", "QT_FAULTS_SEED")}
+            if armed and name == "r0" and attempt == 0:
+                env.update(kill_plan.env())
+            elif armed and not smoke:
+                env.update(bg_plan.env())
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--replica", "--replica-name", name,
+                   "--port", str(ports[name]),
+                   "--replica-sink", sinks[name],
+                   "--budget-ms", str(budget_ms)]
+            if smoke:
+                cmd.append("--replica-fake")
+            return subprocess.Popen(cmd, env=env,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+
+        # the staleness horizon sits BELOW the restart backoff on
+        # purpose: the aggregator must detect + the router must drain
+        # BEFORE the supervisor heals (detect -> drain -> restart ->
+        # re-admit, every stage observable)
+        sup = qfleet.ReplicaSupervisor(
+            spawn, 3, names=names, backoff_s=1.2, backoff_cap_s=2.4,
+            monitor_interval_s=0.05, healthy_uptime_s=10.0,
+            sink=ev_sink).start()
+        agg = qfleet.FleetAggregator(sinks, interval_s=0.2,
+                                     stale_after_s=0.4, sink=ev_sink)
+        router = qfleet.HealthRouter(names, seed=3)
+        agg.on_poll.append(router.sync)
+        cli = qrpc.RpcClient(
+            {n: ("127.0.0.1", p) for n, p in ports.items()},
+            router=router, timeout_ms=500.0, retries=3,
+            backoff_ms=20.0, backoff_cap_ms=150.0, hedge=True,
+            hedge_delay_ms=60.0, seed=5)
+        lat = {}
+        errors = {}
+        try:
+            deadline = time.monotonic() + (30.0 if smoke else 300.0)
+            up = set()
+            while time.monotonic() < deadline and len(up) < 3:
+                for n in names:
+                    if n not in up:
+                        try:
+                            if cli.ping(n, timeout_ms=400)["ok"]:
+                                up.add(n)
+                        except Exception:
+                            pass
+                time.sleep(0.1)
+            if up != set(names):
+                raise RuntimeError(f"fleet never came up: {sorted(up)}")
+            # the aggregator's staleness clock starts only once the
+            # fleet is actually up — a replica still booting must not
+            # read as a detected failure
+            agg.start()
+            futs = []
+            t0 = time.perf_counter()
+            for k in range(n_req):
+                target = t0 + k / rate_rps
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                fut = cli.lookup_future(k % 50, budget_ms=8_000.0)
+                t_sub = time.perf_counter()
+                fut.add_done_callback(
+                    lambda f, i=k, t=t_sub:
+                    lat.setdefault(i, time.perf_counter() - t))
+                futs.append((k, fut))
+            offered_s = time.perf_counter() - t0
+            ok = 0
+            ok_keys = []
+            for k, fut in futs:
+                try:
+                    row = fut.result(timeout=60)
+                    if smoke:
+                        np.testing.assert_array_equal(
+                            row, fake_row(k % 50))
+                    ok += 1
+                    ok_keys.append(k)
+                except qrpc.RpcError as e:
+                    errors[type(e).__name__] = \
+                        errors.get(type(e).__name__, 0) + 1
+            drained_s = time.perf_counter() - t0
+            recovery_s = None
+            if armed:
+                # recovery: the restarted victim answers again
+                deadline = time.monotonic() + 30.0
+                t_serve = None
+                while time.monotonic() < deadline and t_serve is None:
+                    st = sup.status()
+                    if st["r0"]["alive"] and st["r0"]["restarts"] >= 1:
+                        try:
+                            if cli.ping("r0", timeout_ms=400)["ok"]:
+                                t_serve = time.time()
+                        except Exception:
+                            pass
+                    if t_serve is None:
+                        time.sleep(0.1)
+                status = sup.status()
+            else:
+                status, t_serve = sup.status(), None
+        finally:
+            cli_stats = cli.stats()
+            cli.close()
+            agg.close()
+            sup.close()
+            ev_sink.close()
+        events = read_jsonl(ev_path)
+        exits = [r for r in events if r.get("kind") == "chaos"
+                 and r.get("event") == "exit"
+                 and r.get("replica") == "r0"]
+        # only staleness flagged AT/AFTER the exit counts as detecting
+        # THIS failure (a startup blip would fake a negative latency)
+        stales = [r for r in events if r.get("kind") == "anomaly"
+                  and r.get("detector") == "staleness"
+                  and r.get("replica") == "r0"
+                  and exits and r["ts"] >= exits[0]["ts"]]
+        detection_s = (round(stales[0]["ts"] - exits[0]["ts"], 3)
+                       if exits and stales else None)
+        if armed and exits and t_serve is not None:
+            recovery_s = round(t_serve - exits[0]["ts"], 3)
+        # ACCEPTED-request percentiles only: a request that burned its
+        # whole budget into a typed failure must not inflate the p99
+        # the name says is accepted-only (it is already charged to
+        # error_rate)
+        lats = sorted(lat[k] for k in ok_keys if k in lat)
+        pct = lambda q: (round(1e3 * lats[
+            min(int(q * len(lats)), len(lats) - 1)], 2)
+            if lats else None)
+        return {
+            "requests": n_req,
+            "accepted": ok,
+            "errors": errors,
+            "error_rate": round(sum(errors.values()) / n_req, 4),
+            "accepted_rps": round(ok / drained_s, 1) if drained_s else 0,
+            "offered_rps": round(n_req / offered_s, 1),
+            "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "victim_restarts": status["r0"]["restarts"],
+            "breaker_open": status["r0"]["breaker_open"],
+            "detection_s": detection_s,
+            "recovery_s": recovery_s,
+            "client": {k: cli_stats.get(k) for k in
+                       ("retries", "hedges", "hedge_wins", "errors")},
+        }
+
+    clean = run_arm(False)
+    chaos = run_arm(True)
+    out = {
+        "rate_rps": round(rate_rps, 1),
+        "kill_after_requests": CHAOS_KILL_AFTER,
+        "clean": clean,
+        "chaos": chaos,
+        "chaos_accepted_p99_ratio": (
+            round(chaos["p99_ms"] / clean["p99_ms"], 3)
+            if chaos["p99_ms"] and clean["p99_ms"] else None),
+        "chaos_error_rate": chaos["error_rate"],
+        "chaos_detection_s": chaos["detection_s"],
+        "chaos_recovery_s": chaos["recovery_s"],
+    }
+    return out
+
+
 def accuracy_tradeoff(qv, jax, engine, n_nodes, probes=512, reps=2):
     """Argmax agreement of each fanout variant against the variant-0
     reference on a fixed probe set (plus variant 0 against itself — the
@@ -392,7 +699,23 @@ def main():
                     default=bool(os.environ.get("QT_SERVE_SMOKE")))
     ap.add_argument("--platform", default=os.environ.get(
         "QT_BENCH_PLATFORM", ""))
+    ap.add_argument("--chaos-only", action="store_true",
+                    help="run ONLY the chaos kill A/B (real serve "
+                         "replicas unless --smoke) — the chip_suite "
+                         "`chaos` section")
+    ap.add_argument("--replica", action="store_true",
+                    help="run as ONE serve replica (spawned by the "
+                         "chaos supervisor, not by hand)")
+    ap.add_argument("--replica-fake", action="store_true",
+                    help="with --replica: jax-free deterministic "
+                         "backend (the smoke fleet)")
+    ap.add_argument("--replica-name", default="r0")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--replica-sink", default="")
     args_cli = ap.parse_args()
+
+    if args_cli.replica:
+        return run_replica(args_cli)
 
     if args_cli.platform:
         os.environ["JAX_PLATFORMS"] = args_cli.platform
@@ -409,6 +732,32 @@ def main():
 
     jax = configure_jax()
     import quiver_tpu as qv
+
+    if args_cli.chaos_only:
+        t_start = time.time()
+        res = chaos_ab(args_cli.smoke, args_cli.budget_ms)
+        rec = {
+            "metric": CHAOS_METRIC,
+            "value": res["chaos"]["accepted_rps"],
+            "unit": "requests/s",
+            "platform": ("cpu-smoke"
+                         if platform in ("cpu", "default") else platform),
+            "chaos_ab": res,
+            "elapsed_s": round(time.time() - t_start, 1),
+        }
+        if not args_cli.smoke:
+            # the tracked lower-is-better trajectory keys come ONLY
+            # from real-replica runs: a fake-fleet recovery (~1.5 s —
+            # no jax boot) would become the best-prior minimum and
+            # fail every honest real run forever
+            for k in ("chaos_accepted_p99_ratio", "chaos_error_rate",
+                      "chaos_detection_s", "chaos_recovery_s"):
+                rec[k] = res[k]
+        else:
+            rec["skipped_trajectory_keys"] = "smoke fleet (fake " \
+                "replicas) is not a comparable number"
+        _emit(rec)
+        return 0
 
     class W:
         pass
@@ -553,6 +902,11 @@ def main():
     fleet_ab = fleet_plane_ab(qv, co_engine, co_cfg, ab_rate, trial_s,
                               n_nodes, best_of, budget_ms)
 
+    # -- chaos kill A/B (smoke only here: jax-free fake replicas prove
+    # the harness + JSON contract; the comparable real-replica number
+    # comes from `--chaos-only`, chip_suite's `chaos` section) --------------
+    chaos = chaos_ab(True, budget_ms) if args_cli.smoke else None
+
     # -- batch-size x deadline sweep at half the sustained load --------------
     sweep = []
     sweep_rate = max(co_rps / 2.0, 16.0)
@@ -587,6 +941,11 @@ def main():
         trials={"serial": serial_trials, "coalesced": co_trials},
         elapsed_s=round(time.time() - t_start, 1),
     )
+    if chaos is not None:
+        # nested only, NOT under the tracked chaos_* trajectory keys:
+        # the smoke fleet's fake replicas prove the harness, not a
+        # number comparable with the real --chaos-only run
+        rec["chaos_ab"] = chaos
     _emit(rec)
     return 0
 

@@ -56,9 +56,14 @@ from .serving import (MicroBatchServer, OverloadError, ServeConfig,
                       ServeEngine, build_serve_step)
 from .telemetry import FlightRecorder, PlanContext, TelemetryHub
 from .profile import StageProfiler, machine_probe
-from .fleet import FleetAggregator, FleetExporter, health_score
+from .fleet import (FleetAggregator, FleetExporter, HealthRouter,
+                    ReplicaSupervisor, health_score)
+from .faults import FaultPlan, FaultRule
+from .rpc import (RpcClient, RpcError, RpcServer, DeadlineExceeded,
+                  ServerClosed)
 from . import (analysis, comm, profiling, checkpoint, datasets, debug,
-               fleet, metrics, profile, serving, telemetry, tracing)
+               faults, fleet, metrics, profile, rpc, serving,
+               telemetry, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -133,5 +138,14 @@ __all__ = [
     "machine_probe",
     "FleetAggregator",
     "FleetExporter",
+    "HealthRouter",
+    "ReplicaSupervisor",
     "health_score",
+    "FaultPlan",
+    "FaultRule",
+    "RpcClient",
+    "RpcError",
+    "RpcServer",
+    "DeadlineExceeded",
+    "ServerClosed",
 ]

@@ -25,6 +25,16 @@ runs in milliseconds and inside ``scripts/lint.sh``.
                        ``.block_until_ready()``, ``.item()``/
                        ``.tolist()``, or ``np.asarray``/``np.array``
                        (all of which silently device_get a jax array).
+``swallowed_worker_exception``
+                       a bare / over-broad ``except`` (``except:``,
+                       ``except Exception``, ``except BaseException``)
+                       inside a ``while`` loop whose handler neither
+                       re-raises, nor calls anything (logging, a
+                       counter method, failing a future), nor mutates
+                       state (a ``+= 1`` counter) — the worker-loop
+                       swallow the fault injector keeps finding: the
+                       loop looks healthy while silently dropping its
+                       work. Count it, log it, or re-raise it.
 """
 
 from __future__ import annotations
@@ -274,11 +284,74 @@ def _check_hot_path_blocking(tree, path: str) -> List[Finding]:
     return out
 
 
+_BROAD_EXC = ("Exception", "BaseException")
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    """bare ``except:`` or ``except Exception/BaseException``
+    (including as one element of a tuple)."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in types:
+        name = e.attr if isinstance(e, ast.Attribute) else \
+            getattr(e, "id", "")
+        if name in _BROAD_EXC:
+            return True
+    return False
+
+
+def _handler_reacts(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body count, log, or re-raise? Any ``raise``,
+    any call (logging, a counter/stat method, failing a future), or
+    any assignment/aug-assignment (``self.errors += 1``) counts as a
+    reaction; ``pass``/``continue``/``break``/bare returns do not."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Call, ast.AugAssign,
+                             ast.Assign)):
+            return True
+    return False
+
+
+def _check_swallowed_worker_exception(tree, path: str) -> List[Finding]:
+    out = []
+
+    def scan(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                scan(child, False)      # a nested scope is its own loop
+                continue
+            # ``while`` loops only: a worker loop spins until told to
+            # stop; a bounded ``for`` (shutdown best-effort sweeps,
+            # result collection) retires its work either way, and its
+            # swallow is judged by the surrounding code
+            inner = in_loop or isinstance(child, ast.While)
+            if isinstance(child, ast.ExceptHandler) and in_loop and \
+                    _is_broad_handler(child) and \
+                    not _handler_reacts(child):
+                what = ("bare except" if child.type is None
+                        else f"except {ast.unparse(child.type)}")
+                out.append(Finding(
+                    "swallowed_worker_exception", ERROR,
+                    f"{path}:{child.lineno}",
+                    f"{what} inside a worker loop neither counts, "
+                    "logs, nor re-raises — the loop keeps spinning "
+                    "while silently dropping its work (the class the "
+                    "fault injector keeps finding); increment a "
+                    "counter, log once, or re-raise"))
+            scan(child, inner)
+
+    scan(tree, False)
+    return out
+
+
 _CHECKS = (_check_lock_held_emit, _check_resource_finalizer,
-           _check_hot_path_blocking)
+           _check_hot_path_blocking, _check_swallowed_worker_exception)
 
 HOST_RULES = ("lock_held_emit", "resource_finalizer",
-              "hot_path_blocking")
+              "hot_path_blocking", "swallowed_worker_exception")
 
 
 def check_source(src: str, path: str = "<string>") -> List[Finding]:

@@ -756,6 +756,12 @@ class Feature:
             vec[_m.IO_READ_ROWS] = int(io[1])
             vec[_m.IO_READ_BYTES] = int(min(io[2], 2**31 - 1))
             vec[_m.IO_DEPTH_PEAK] = int(io[3])
+            vec[_m.IO_RETRIES] = int(io[4])
+            vec[_m.STAGING_RESTARTS] = int(io[5])
+        # faults fired since the last metered lookup (process-global:
+        # the armed FaultPlan counts every site; 0 when disarmed)
+        from . import faults as _faults
+        vec[_m.FAULTS_INJECTED] = _faults.drain_injected()
         return rows, vec
 
     def prefetch(self, node_idx):

@@ -62,16 +62,20 @@ from __future__ import annotations
 
 import collections
 import json
+import logging
+import random
 import threading
 import time
 import weakref
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import metrics as _metrics
 from .telemetry import TelemetryHub
 
-__all__ = ["FleetAggregator", "FleetExporter", "health_score",
-           "prometheus_text"]
+__all__ = ["FleetAggregator", "FleetExporter", "HealthRouter",
+           "ReplicaSupervisor", "health_score", "prometheus_text"]
+
+_log = logging.getLogger("quiver_tpu.fleet")
 
 
 def health_score(burn: Optional[float] = None, shed_frac: float = 0.0,
@@ -182,6 +186,11 @@ class FleetAggregator:
         self.anomalies: "collections.deque" = collections.deque(
             maxlen=64)
         self.polls = 0
+        self.poll_errors = 0
+        # observers called with each poll's snapshot AFTER every lock
+        # releases (same discipline as sink emission) — how a
+        # HealthRouter follows the aggregator's verdicts live
+        self.on_poll: List[Callable[[dict], None]] = []
         self._t_start = self._clock()
         # two locks: _poll_lock serializes whole aggregation passes
         # (file reads + hub folds + any sink emission the fleet hub's
@@ -276,6 +285,11 @@ class FleetAggregator:
             for rec in staleness:
                 self.sink.emit(rec, kind="anomaly")
             self.sink.emit(snap, kind="fleet")
+        for cb in list(self.on_poll):
+            try:
+                cb(snap)
+            except Exception:
+                _log.exception("fleet on_poll observer failed")
         return snap
 
     def _snapshot_locked(self, now: float) -> dict:
@@ -309,6 +323,7 @@ class FleetAggregator:
                 "health_min": round(min(healths), 4),
                 "health_mean": round(sum(healths) / len(healths), 4),
                 "polls": self.polls,
+                "poll_errors": self.poll_errors,
             },
         }
 
@@ -348,8 +363,12 @@ class FleetAggregator:
         while not self._stop.wait(self.interval_s):
             try:
                 self.poll()
-            except Exception:         # a torn file mid-write must not
-                continue              # kill the plane; next poll heals
+            except Exception:
+                # a torn file mid-write must not kill the plane (the
+                # next poll heals) — but the swallow is COUNTED, never
+                # silent (the swallowed_worker_exception lint class)
+                with self._lock:
+                    self.poll_errors += 1
 
     def close(self) -> None:
         """Stop the polling thread and join it. Idempotent."""
@@ -360,6 +379,407 @@ class FleetAggregator:
             t.join(timeout=10.0)
 
     def __enter__(self) -> "FleetAggregator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- health-weighted routing ---------------------------------------------------
+
+
+class HealthRouter:
+    """Health-weighted replica selection with drain/re-admit hysteresis
+    — the router ROADMAP frontier 4(c) describes, consuming
+    :func:`health_score` verdicts (typically the
+    :class:`FleetAggregator`'s, via ``agg.on_poll.append(router.sync)``).
+
+    - :meth:`pick` draws a replica weighted by its health score
+      (seeded ``random.Random`` — reproducible), never a drained one
+      while an active one exists;
+    - :meth:`ranked` lists replicas healthiest-first (what the RPC
+      client's retry/hedge path walks) with drained replicas LAST —
+      a last resort, not a routing target;
+    - **drain hysteresis**: a replica whose score falls below
+      ``drain_below`` (staleness scores 0, so a dead replica drains on
+      the first sync) is drained — no new traffic routes to it, while
+      requests already in flight re-route through the client's retry
+      path rather than being dropped — and re-admits only once its
+      score recovers past ``readmit_above`` (two thresholds, so a
+      replica hovering at the boundary doesn't flap).
+
+    Scores arrive via :meth:`update` / :meth:`sync`; unknown replicas
+    auto-register (score 1.0 until told otherwise). ``snapshot()``
+    is one JSONL-ready dict."""
+
+    def __init__(self, names: Sequence[str] = (), seed: int = 0,
+                 drain_below: float = 0.25, readmit_above: float = 0.5):
+        if not 0.0 <= drain_below <= readmit_above <= 1.0:
+            raise ValueError(
+                f"need 0 <= drain_below <= readmit_above <= 1, got "
+                f"{drain_below} / {readmit_above}")
+        self.drain_below = float(drain_below)
+        self.readmit_above = float(readmit_above)
+        self._scores: Dict[str, float] = {str(n): 1.0 for n in names}
+        self._drained: set = set()
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.picks = 0
+        self.drains = 0
+        self.readmits = 0
+
+    def update(self, name: str, score: float) -> None:
+        """Fold one replica's health score (clamped to [0, 1]) and run
+        the drain/re-admit hysteresis."""
+        name = str(name)
+        score = min(max(float(score), 0.0), 1.0)
+        with self._lock:
+            self._scores[name] = score
+            if name in self._drained:
+                if score >= self.readmit_above:
+                    self._drained.discard(name)
+                    self.readmits += 1
+            elif score < self.drain_below:
+                self._drained.add(name)
+                self.drains += 1
+
+    def sync(self, snapshot: dict) -> None:
+        """Fold a :class:`FleetAggregator` snapshot (per-replica
+        ``health`` values) — the shape ``agg.on_poll`` delivers."""
+        for name, rec in (snapshot.get("replicas") or {}).items():
+            h = rec.get("health")
+            if h is not None:
+                self.update(name, h)
+
+    def drain(self, name: str) -> None:
+        """Manually drain (deploys, maintenance): no new traffic until
+        :meth:`readmit` or a recovered score re-admits it."""
+        with self._lock:
+            self._drained.add(str(name))
+            self.drains += 1
+
+    def readmit(self, name: str) -> None:
+        with self._lock:
+            self._drained.discard(str(name))
+            self.readmits += 1
+
+    def _active(self, exclude) -> Tuple[List[str], List[str]]:
+        ex = set(exclude)
+        active = [n for n in self._scores
+                  if n not in self._drained and n not in ex]
+        rest = [n for n in self._scores
+                if n not in ex and n not in active]
+        return active, rest
+
+    def ranked(self, exclude: Sequence[str] = ()) -> List[str]:
+        """Replicas healthiest-first; drained ones LAST (a retry path
+        may still try them when nothing healthy remains). Excluded
+        names (this request's already-failed replicas) drop entirely
+        unless that would leave nothing."""
+        with self._lock:
+            active, rest = self._active(exclude)
+            out = (sorted(active, key=lambda n: (-self._scores[n], n))
+                   + sorted(rest, key=lambda n: (-self._scores[n], n)))
+            if not out:
+                out = sorted(self._scores,
+                             key=lambda n: (-self._scores[n], n))
+            return out
+
+    def pick(self, exclude: Sequence[str] = ()) -> str:
+        """One replica, drawn with probability proportional to health
+        among the non-drained set (a replica at health 0.3 takes 3x
+        less traffic than one at 0.9 — shed pressure routes AWAY
+        before the SLO blows, the planned trade)."""
+        with self._lock:
+            active, rest = self._active(exclude)
+            pool = active or rest or list(self._scores)
+            if not pool:
+                raise ValueError("router knows no replicas")
+            weights = [max(self._scores.get(n, 1.0), 1e-6)
+                       for n in pool]
+            total = sum(weights)
+            x = self._rng.random() * total
+            self.picks += 1
+            for n, w in zip(pool, weights):
+                x -= w
+                if x <= 0:
+                    return n
+            return pool[-1]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"scores": dict(self._scores),
+                    "drained": sorted(self._drained),
+                    "picks": self.picks, "drains": self.drains,
+                    "readmits": self.readmits}
+
+
+# -- replica supervision -------------------------------------------------------
+
+
+class _Child:
+    """One supervised replica's state (internal)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.proc = None
+        self.spawned_at: Optional[float] = None
+        self.next_restart_at: Optional[float] = 0.0   # 0 = spawn now
+        self.spawned_ever = False
+        self.restarts = 0
+        self.consecutive = 0          # crashes without healthy uptime
+        self.crash_times: collections.deque = collections.deque(maxlen=64)
+        self.breaker_open = False
+        self.last_rc: Optional[int] = None
+
+
+class ReplicaSupervisor:
+    """Spawn N serve replicas as REAL processes and keep them alive:
+    crashed replicas restart under capped exponential backoff, and a
+    crash LOOP (``crash_loop_limit`` crashes inside
+    ``crash_loop_window_s``) opens a circuit breaker — restarting a
+    replica that dies on arrival every time only burns CPU and floods
+    logs; the breaker holds for ``breaker_reset_s``, then clears the
+    crash history and tries once more (half-open).
+
+    ``spawn(name, index, attempt)`` returns a started
+    ``subprocess.Popen`` — the supervisor owns WHEN processes run,
+    the caller owns WHAT they run (the chaos harness spawns fake
+    stdlib replicas; the bench spawns real serve replicas). A replica
+    that stays up ``healthy_uptime_s`` resets its consecutive-crash
+    count, so one crash a day pays the MINIMUM backoff, not an
+    ever-growing one.
+
+    Lifecycle events (spawn / exit / breaker transitions) append to
+    ``sink`` as ``chaos`` JSONL records and to the in-memory
+    ``events`` deque. ``kill(name)`` is the chaos harness's trigger
+    (SIGKILL by default — the crash the restart path must survive).
+    ``close()`` stops the monitor and terminates the children
+    (SIGTERM, then SIGKILL after ``grace_s``)."""
+
+    def __init__(self, spawn: Callable, count: int,
+                 names: Optional[Sequence[str]] = None,
+                 backoff_s: float = 0.25, backoff_cap_s: float = 8.0,
+                 crash_loop_limit: int = 5,
+                 crash_loop_window_s: float = 30.0,
+                 breaker_reset_s: Optional[float] = None,
+                 healthy_uptime_s: Optional[float] = None,
+                 monitor_interval_s: float = 0.1,
+                 grace_s: float = 2.0, sink=None, clock=None):
+        if count < 1 and not names:
+            raise ValueError("need at least one replica")
+        self._spawn = spawn
+        self.names = ([str(n) for n in names] if names
+                      else [f"r{i}" for i in range(count)])
+        if len(set(self.names)) != len(self.names):
+            raise ValueError(f"duplicate replica names in {self.names}")
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.crash_loop_limit = int(crash_loop_limit)
+        self.crash_loop_window_s = float(crash_loop_window_s)
+        self.breaker_reset_s = (float(breaker_reset_s)
+                                if breaker_reset_s is not None
+                                else 2.0 * self.crash_loop_window_s)
+        self.healthy_uptime_s = (float(healthy_uptime_s)
+                                 if healthy_uptime_s is not None
+                                 else self.crash_loop_window_s)
+        self.monitor_interval_s = float(monitor_interval_s)
+        self.grace_s = float(grace_s)
+        self.sink = sink
+        self._clock = clock if clock is not None else time.monotonic
+        self._children = {n: _Child(n) for n in self.names}
+        self.events: collections.deque = collections.deque(maxlen=256)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._finalizer = weakref.finalize(self, self._stop.set)
+
+    # -- events --------------------------------------------------------------
+    def _event(self, **rec) -> None:
+        """Record one lifecycle event (sink emission OUTSIDE any
+        lock, per the lock_held_emit contract — callers ensure it)."""
+        self.events.append(rec)
+        if self.sink is not None:
+            self.sink.emit(rec, kind="chaos")
+
+    # -- the monitor ---------------------------------------------------------
+    def start(self) -> "ReplicaSupervisor":
+        """Spawn every replica now and spin the monitor thread."""
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("supervisor is closed")
+            if self._thread is None:
+                t = threading.Thread(target=self._monitor,
+                                     name="qt-replica-supervisor",
+                                     daemon=True)
+                t.start()
+                self._thread = t
+        return self
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.monitor_interval_s):
+            try:
+                self.step()
+            except Exception:
+                # one bad spawn attempt must not kill supervision of
+                # the other replicas — counted via an event, retried
+                # on the next tick
+                self._event(event="monitor_error")
+
+    def step(self) -> None:
+        """One supervision pass (the monitor thread's body; tests call
+        it directly under a fake clock for determinism)."""
+        now = self._clock()
+        events = []
+        try:
+            with self._lock:
+                for c in self._children.values():
+                    self._step_child(c, now, events)
+        finally:
+            for rec in events:         # outside the lock: sink IO
+                self._event(**rec)
+
+    def _step_child(self, c: _Child, now: float, events: list) -> None:
+        if c.proc is not None:
+            rc = c.proc.poll()
+            if rc is None:
+                if c.consecutive and c.spawned_at is not None and \
+                        now - c.spawned_at >= self.healthy_uptime_s:
+                    # earned a clean slate: the next crash pays the
+                    # MINIMUM backoff and the breaker window restarts
+                    c.consecutive = 0
+                    c.crash_times.clear()
+                return
+            # the replica died: schedule the restart under backoff
+            c.last_rc = rc
+            c.proc = None
+            self._crash_ladder(c, now, events,
+                               dict(event="exit", rc=rc))
+            return
+        # no process: spawn when its restart time arrives
+        if c.next_restart_at is None or now < c.next_restart_at:
+            return
+        if c.breaker_open:
+            # half-open: the cool-down elapsed — clear history, try once
+            c.breaker_open = False
+            c.crash_times.clear()
+            c.consecutive = 0
+            events.append(dict(event="breaker_reset", replica=c.name))
+        first = not c.spawned_ever
+        attempt = 0 if first else c.restarts + 1
+        try:
+            proc = self._spawn(c.name, self.names.index(c.name),
+                               attempt)
+        except Exception as e:
+            # a failing spawn() is a crash that never got a pid: it
+            # pays the SAME backoff/breaker ladder (a bad binary must
+            # not hot-loop at the monitor interval), and it must not
+            # abort this pass — the other children still get stepped
+            self._crash_ladder(c, now, events,
+                               dict(event="spawn_error",
+                                    error=repr(e)))
+            return
+        c.proc = proc
+        c.spawned_ever = True
+        c.spawned_at = now
+        c.next_restart_at = None
+        if not first:
+            c.restarts += 1
+        events.append(dict(
+            event="spawn" if first else "restart", replica=c.name,
+            pid=c.proc.pid, attempt=attempt))
+
+    def _crash_ladder(self, c: _Child, now: float, events: list,
+                      event: dict) -> None:
+        """The one backoff/circuit-breaker ladder both crash shapes
+        pay — a process exit and a failing ``spawn()`` differ only in
+        their event payload."""
+        c.crash_times.append(now)
+        c.consecutive += 1
+        recent = sum(1 for t in c.crash_times
+                     if now - t <= self.crash_loop_window_s)
+        if recent >= self.crash_loop_limit and not c.breaker_open:
+            c.breaker_open = True
+            c.next_restart_at = now + self.breaker_reset_s
+            events.append(dict(
+                event, event="breaker_open", replica=c.name,
+                crashes_in_window=recent,
+                retry_in_s=round(self.breaker_reset_s, 3)))
+            return
+        backoff = min(self.backoff_cap_s,
+                      self.backoff_s * (2 ** (c.consecutive - 1)))
+        c.next_restart_at = now + backoff
+        events.append(dict(
+            event, replica=c.name, consecutive=c.consecutive,
+            restart_in_s=round(backoff, 3)))
+
+    # -- chaos + introspection ------------------------------------------------
+    def kill(self, name: str, sig=None) -> Optional[int]:
+        """SIGKILL (default) a replica — the chaos trigger. Returns the
+        killed pid, or None if it was not running."""
+        import signal
+        with self._lock:
+            c = self._children[str(name)]
+            proc = c.proc
+        if proc is None or proc.poll() is not None:
+            return None
+        proc.send_signal(signal.SIGKILL if sig is None else sig)
+        return proc.pid
+
+    def status(self) -> dict:
+        """Per-replica ``{pid, alive, rc, restarts, consecutive,
+        breaker_open, next_restart_in_s}`` snapshot."""
+        now = self._clock()
+        with self._lock:
+            out = {}
+            for c in self._children.values():
+                alive = c.proc is not None and c.proc.poll() is None
+                out[c.name] = {
+                    "pid": c.proc.pid if c.proc is not None else None,
+                    "alive": alive,
+                    "rc": c.last_rc,
+                    "restarts": c.restarts,
+                    "consecutive_crashes": c.consecutive,
+                    "breaker_open": c.breaker_open,
+                    "next_restart_in_s": (
+                        None if c.next_restart_at is None
+                        else round(max(c.next_restart_at - now, 0.0), 3)),
+                }
+            return out
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and not self._stop.is_set()
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        """Stop the monitor, terminate the children (SIGTERM, SIGKILL
+        after ``grace_s``), reap them. Idempotent."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+        with self._lock:
+            procs = [c.proc for c in self._children.values()
+                     if c.proc is not None]
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.grace_s
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.0))
+            except Exception:
+                try:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                except Exception:
+                    pass
+
+    def __enter__(self) -> "ReplicaSupervisor":
         return self
 
     def __exit__(self, *exc) -> None:

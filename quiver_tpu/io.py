@@ -41,14 +41,18 @@ pins.
 
 from __future__ import annotations
 
+import errno as _errno
 import mmap as _mmap
 import os
 import threading
+import time as _time
 import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
 import numpy as np
+
+from . import faults
 
 #: O_DIRECT alignment: offsets, lengths and buffer addresses must be
 #: multiples of the logical block size; 4096 satisfies every common
@@ -59,6 +63,16 @@ DIRECT_ALIGNMENT = 4096
 #: split, so one long coalesced run cannot serialize the whole queue
 #: behind a single request.
 DEFAULT_IO_CAP_BYTES = 1 << 20
+
+#: transient read errors worth retrying before falling back: an
+#: interrupted syscall, a momentarily unready device, a one-off media
+#: error the next attempt may not see.
+TRANSIENT_ERRNOS = (_errno.EINTR, _errno.EAGAIN, _errno.EIO)
+
+#: per-extent retry budget (attempts beyond the first) and the base of
+#: the exponential backoff between them.
+IO_READ_RETRIES = 3
+IO_RETRY_BACKOFF_S = 0.001
 
 
 # -- pure extent math (host, tested exhaustively) ---------------------------
@@ -377,9 +391,67 @@ class ExtentReader:
         return total
 
     def _read_extent(self, out: np.ndarray, pos: int, start_row: int,
-                     n_rows: int) -> int:
-        """Read one extent into ``out[pos : pos + n_rows]``; returns
-        the bytes the device moved (aligned length under O_DIRECT)."""
+                     n_rows: int, acct: Optional[dict] = None) -> int:
+        """Read one extent into ``out[pos : pos + n_rows]`` with the
+        resilience ladder: transient errors (``TRANSIENT_ERRNOS`` —
+        EINTR/EAGAIN/EIO, including injected ones: the ``io.read``
+        fault site fires per attempt) retry up to ``IO_READ_RETRIES``
+        times under exponential backoff, then the extent falls back to
+        a per-extent mmap read (same bytes, page-fault path); only
+        when THAT also fails does the extent raise — loudly, naming
+        the extent — so a permanently failing fd surfaces at the
+        lookup and never returns short rows. ``acct`` (this call's
+        holder) counts ``retries``/``fallback_extents``. Returns the
+        bytes the device moved."""
+        last: Optional[BaseException] = None
+        for attempt in range(1 + IO_READ_RETRIES):
+            try:
+                faults.fire("io.slow")
+                faults.fire("io.read")
+                return self._read_extent_once(out, pos, start_row,
+                                              n_rows)
+            except OSError as e:
+                last = e
+                if e.errno not in TRANSIENT_ERRNOS:
+                    break                # permanent: straight to mmap
+                if attempt < IO_READ_RETRIES:
+                    if acct is not None:
+                        with self._depth_lock:
+                            acct["retries"] = acct.get("retries", 0) + 1
+                    _time.sleep(IO_RETRY_BACKOFF_S * (2 ** attempt))
+        # retries exhausted (or permanent error): per-extent mmap
+        # fallback — the compat path reads the same bytes through the
+        # page cache, so a flaky fd degrades to QD1 for THIS extent
+        # instead of stranding the whole staging future
+        try:
+            rows = self._fallback_mmap()[start_row:start_row + n_rows]
+            out[pos:pos + n_rows] = rows
+        except BaseException:
+            raise OSError(
+                getattr(last, "errno", _errno.EIO) or _errno.EIO,
+                f"extent (start_row={start_row}, n_rows={n_rows}) of "
+                f"{self.path} failed after {IO_READ_RETRIES} retries "
+                f"AND the mmap fallback; last error: {last}") from last
+        if acct is not None:
+            with self._depth_lock:
+                acct["fallback_extents"] = \
+                    acct.get("fallback_extents", 0) + 1
+        return n_rows * self.row_bytes
+
+    def _fallback_mmap(self) -> np.ndarray:
+        """Lazily built per-reader memmap over the same file region —
+        the per-extent degraded read path (never the fast path)."""
+        mm = self._mm
+        if mm is None:
+            mm = np.memmap(self.path, self.dtype, mode="r",
+                           offset=self.base_offset, shape=self.shape)
+            self._mm = mm
+        return mm
+
+    def _read_extent_once(self, out: np.ndarray, pos: int,
+                          start_row: int, n_rows: int) -> int:
+        """One read attempt (no retry): O_DIRECT scratch or buffered
+        preadv straight into ``out[pos : pos + n_rows]``."""
         length = n_rows * self.row_bytes
         offset = self.base_offset + start_row * self.row_bytes
         dst = out[pos:pos + n_rows]
@@ -410,10 +482,11 @@ class ExtentReader:
         serially (the slot holds at most one request in flight, so
         depth accounting is per SPAN — two lock takes per extent was
         measurable overhead at thousands of extents/publication).
-        ``peak`` is the CALL's own peak holder: the in-flight count is
+        ``peak`` is the CALL's own holder: the in-flight count is
         shared (the device sees every caller's requests) but each
         read_rows reports the depth ITS spans observed — a shared
-        reset would race under concurrent staging workers."""
+        reset would race under concurrent staging workers; the
+        retry/fallback counts ride the same holder."""
         with self._depth_lock:
             self._inflight += 1
             peak["depth"] = max(peak["depth"], self._inflight)
@@ -422,7 +495,7 @@ class ExtentReader:
             for i in idx:
                 moved += self._read_extent(out, int(pos[i]),
                                            int(extents[i, 0]),
-                                           int(extents[i, 1]))
+                                           int(extents[i, 1]), peak)
             return moved
         finally:
             with self._depth_lock:
@@ -432,14 +505,15 @@ class ExtentReader:
         """Read the (sorted unique) ``rows`` at full queue depth.
         Returns ``(out, stats)``: a ``[n, dim]`` array of the storage
         dtype, bit-identical to ``mmap[rows]``, plus this call's IO
-        facts — ``{"extents", "rows", "bytes", "depth_peak"}`` — for
-        the metrics slots."""
+        facts — ``{"extents", "rows", "bytes", "depth_peak",
+        "retries", "fallback_extents"}`` — for the metrics slots."""
         if self._closed:
             raise RuntimeError("ExtentReader is closed")
         rows = np.asarray(rows, np.int64).ravel()
         extents = plan_extents(rows, self.row_bytes, self.io_cap_bytes)
         out = np.empty((rows.size, self.shape[1]), self.dtype)
-        peak = {"depth": 0}          # this CALL's observed depth
+        # this CALL's holder: observed depth + retry/fallback counts
+        peak = {"depth": 0, "retries": 0, "fallback_extents": 0}
         moved = 0
         if self.model is not None:
             # modeled device: charge the deep-queue batch, fetch the
@@ -451,11 +525,12 @@ class ExtentReader:
                 moved = rows.size * self.row_bytes
             return out, {"extents": int(len(extents)),
                          "rows": int(rows.size), "bytes": int(moved),
-                         "depth_peak": int(min(self.qd, len(extents)))}
+                         "depth_peak": int(min(self.qd, len(extents))),
+                         "retries": 0, "fallback_extents": 0}
         if len(extents) == 1:
             # one request: issue inline, no pool round-trip
             moved += self._read_extent(out, 0, int(extents[0, 0]),
-                                       int(extents[0, 1]))
+                                       int(extents[0, 1]), peak)
             peak["depth"] = max(peak["depth"], 1)
         elif len(extents):
             pos = np.zeros(len(extents), np.int64)
@@ -474,7 +549,9 @@ class ExtentReader:
             for f in futs:
                 moved += f.result()
         stats = {"extents": int(len(extents)), "rows": int(rows.size),
-                 "bytes": int(moved), "depth_peak": int(peak["depth"])}
+                 "bytes": int(moved), "depth_peak": int(peak["depth"]),
+                 "retries": int(peak["retries"]),
+                 "fallback_extents": int(peak["fallback_extents"])}
         return out, stats
 
     # -- lifecycle ----------------------------------------------------------

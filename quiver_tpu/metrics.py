@@ -97,8 +97,11 @@ IO_EXTENTS = 16       # coalesced read requests the cold-IO path issued
 IO_READ_ROWS = 17     # disk rows those extents covered
 IO_READ_BYTES = 18    # bytes the storage device moved (saturates int32)
 IO_DEPTH_PEAK = 19    # peak in-flight read requests observed [max slot]
+IO_RETRIES = 20       # transient cold-IO read retries (EINTR/EAGAIN/EIO)
+FAULTS_INJECTED = 21  # faults the armed FaultPlan fired (process-wide)
+STAGING_RESTARTS = 22  # staging workers auto-replaced / shards retried
 
-NUM_COUNTERS = 20
+NUM_COUNTERS = 23
 
 #: slots merged with ``max`` across steps/shards; all others add
 MAX_SLOTS = (EXCH_BUCKET_MAX, EXCH_CAP, IO_DEPTH_PEAK)
@@ -118,6 +121,9 @@ SLOT_NAMES = {
     IO_READ_ROWS: "io_read_rows",
     IO_READ_BYTES: "io_read_bytes",
     IO_DEPTH_PEAK: "io_depth_peak",
+    IO_RETRIES: "io_retries",
+    FAULTS_INJECTED: "faults_injected",
+    STAGING_RESTARTS: "staging_worker_restarts",
 }
 
 _MAX_MASK_NP = np.zeros((NUM_COUNTERS,), bool)
@@ -708,6 +714,8 @@ class MetricsSink:
                          else os.environ.get("QT_REPLICA") or None)
         self._start_ts = time.time()
         self._meta_written = not self._own
+        self.write_errors = 0
+        self._warned_write = False
         self._lock = threading.Lock()
 
     def emit(self, record: dict, kind: Optional[str] = None) -> dict:
@@ -715,14 +723,31 @@ class MetricsSink:
                "kind": kind or record.get("kind", self._kind)}
         rec.update({k: v for k, v in record.items() if k != "kind"})
         line = json.dumps(rec, default=_json_default)
-        with self._lock:
-            if not self._meta_written:
-                self._meta_written = True
-                self._write_meta_locked()
-            self._f.write(line + "\n")
-            self._f.flush()
-            if self._max_bytes and self._f.tell() >= self._max_bytes:
-                self._rollover_locked()
+        try:
+            from . import faults
+            faults.fire("sink.write")    # the injectable disk failure
+            with self._lock:
+                if not self._meta_written:
+                    self._meta_written = True
+                    self._write_meta_locked()
+                self._f.write(line + "\n")
+                self._f.flush()
+                if self._max_bytes and self._f.tell() >= self._max_bytes:
+                    self._rollover_locked()
+        except (OSError, ValueError) as e:
+            # a telemetry sink must never kill the data path it
+            # observes: the failed write is COUNTED (``write_errors``)
+            # and logged once — silently lost records would make a
+            # flaky disk look like a healthy quiet system
+            with self._lock:
+                self.write_errors += 1
+                warn = not self._warned_write
+                self._warned_write = True
+            if warn:
+                import logging
+                logging.getLogger("quiver_tpu.metrics").warning(
+                    "MetricsSink write failed (%s): record dropped; "
+                    "counted in write_errors (warning fires once)", e)
         return rec
 
     def _write_meta_locked(self, kind: str = "meta") -> None:

@@ -38,7 +38,7 @@ import weakref
 from concurrent.futures import Future
 from typing import Callable, Iterable, Iterator, Optional
 
-from . import tracing
+from . import faults, tracing
 
 _STOP = object()
 
@@ -46,6 +46,11 @@ _STOP = object()
 def _worker(q: "queue.Queue", stats: dict, lock: "threading.Lock",
             name: str = "pipeline"):
     while True:
+        # the injectable worker-death site sits BEFORE the queue pop:
+        # a killed worker strands no claimed item, so the watchdog
+        # restart (``_ensure_worker``) resumes the queue with every
+        # future intact
+        faults.fire("pipeline.worker")
         item = q.get()
         if item is _STOP:
             return
@@ -124,6 +129,7 @@ class Pipeline:
         # bottleneck (metrics.StepStats.watch_pipeline consumes this)
         self._stats = {"submitted": 0, "completed": 0, "failed": 0,
                        "cancelled": 0, "dropped": 0, "max_depth": 0,
+                       "worker_restarts": 0,
                        "total_wait_s": 0.0, "max_wait_s": 0.0}
         self._stats_lock = threading.Lock()
         self._finalizer = weakref.finalize(self, _finalize_shutdown,
@@ -135,7 +141,19 @@ class Pipeline:
         with self._lock:
             if self._closed:
                 raise RuntimeError(f"{self._name}: pipeline is closed")
-            if self._box["thread"] is None:
+            cur = self._box["thread"]
+            if cur is not None and not cur.is_alive():
+                # worker-death watchdog: the loop only exits cleanly on
+                # _STOP (sent by close), so a dead thread on an OPEN
+                # pipeline is an unexpected death (an injected
+                # ``pipeline.worker`` fault, a BaseException escaping
+                # the loop) — restart it; the queue and every queued
+                # future survive intact, and the restart is counted
+                self._box["thread"] = None
+                cur = None
+                with self._stats_lock:
+                    self._stats["worker_restarts"] += 1
+            if cur is None:
                 t = threading.Thread(target=_worker,
                                      args=(self._q, self._stats,
                                            self._stats_lock, self._name),
@@ -164,6 +182,21 @@ class Pipeline:
             if fut.cancel():
                 raise RuntimeError(f"{self._name}: pipeline is closed")
         return fut
+
+    def ensure_worker(self) -> bool:
+        """Revive a dead worker WITHOUT submitting (the watchdog's
+        second trigger): a consumer about to BLOCK on an
+        already-queued future must be able to restart the thread that
+        will resolve it — waiting for the next ``submit`` to notice
+        would deadlock a caller that only submits after the wait.
+        Returns False (a no-op) when the pipeline is closed."""
+        if self._closed:
+            return False
+        try:
+            self._ensure_worker()
+        except RuntimeError:
+            return False                 # close() raced us
+        return True
 
     def try_submit(self, fn: Callable, *args, **kwargs) -> Optional[Future]:
         """Non-blocking :meth:`submit`: returns the ``Future``, or

@@ -63,6 +63,7 @@ import weakref
 
 import numpy as np
 
+from . import faults
 from .io import coalescing_factor
 from .ops.dedup import unique_np
 
@@ -281,11 +282,12 @@ class ColdPrefetcher:
         # whole ring — counted and logged ONCE (no silent caps)
         self._truncated = 0
         self._warned_truncate = False
-        # per-interval IO facts [extents, rows read, bytes, depth peak]
-        # (peak merges with max); _io_undrained feeds the metered
-        # lookup's counter slots, _io_total feeds stats()
-        self._io_undrained = np.zeros(4, np.int64)
-        self._io_total = np.zeros(4, np.int64)
+        # per-interval IO facts [extents, rows read, bytes, depth peak,
+        # read retries, staging-worker restarts] (peak merges with
+        # max); _io_undrained feeds the metered lookup's counter
+        # slots, _io_total feeds stats()
+        self._io_undrained = np.zeros(6, np.int64)
+        self._io_total = np.zeros(6, np.int64)
         # wait_inflight: a lookup that misses while a staging task is
         # STILL RUNNING waits for it and re-takes, instead of re-paying
         # the disk read synchronously for rows whose read is already in
@@ -298,7 +300,7 @@ class ColdPrefetcher:
         # feed the telemetry hub INTERVAL deltas (per-window hit rate),
         # not an ever-flattening lifetime average; _hub_t is the
         # interval's time base for the staged-rows/s series
-        self._hub_last = np.zeros(6, np.int64)
+        self._hub_last = np.zeros(7, np.int64)
         self._hub_t = None
         self._lock = threading.Lock()
 
@@ -373,16 +375,78 @@ class ColdPrefetcher:
         # never splits a coalescible extent across workers except at
         # the w-1 shard seams
         w = min(self.workers, int(new.shape[0]))
-        stagers = self._stagers      # one read: close() may null it
-        if w > 1 and stagers is not None:
-            futs = [stagers.submit(self._stage_shard, shard)
-                    for shard in np.array_split(new, w)]
-            staged = sum(f.result() for f in futs)
+        if w > 1 and self._stagers is not None:
+            staged = 0
+            pending = []
+            for shard in np.array_split(new, w):
+                fut = self._submit_shard(shard)
+                if fut is None:          # no pool left: stage inline
+                    staged += self._stage_shard(shard)
+                else:
+                    pending.append((fut, shard))
+            for fut, shard in pending:
+                try:
+                    staged += fut.result()
+                except Exception:
+                    # a staging worker died on this shard (injected
+                    # ``prefetch.stager`` fault, flaky fd past the IO
+                    # ladder): count the restart and retry the shard
+                    # ONCE inline — a second failure propagates and
+                    # fails the publication future loudly (the
+                    # batch's reads then fall back to the synchronous
+                    # path: counted, never wrong)
+                    self._count_stager_restart()
+                    staged += self._stage_shard(shard)
         else:
             staged = self._stage_shard(new)
         with self._lock:
             self._batches_staged += 1
         return staged
+
+    def _submit_shard(self, shard):
+        """Submit one shard to the staging pool, replacing a
+        broken/shut-down pool once (auto-replacing dead staging
+        workers — counted in ``staging_worker_restarts``). Returns
+        None when no usable pool remains (close() raced, or workers=1)
+        — the caller stages inline, correctness unaffected."""
+        for retry in (False, True):
+            stagers = self._stagers  # one read: close() may null it
+            if stagers is None:
+                return None
+            try:
+                return stagers.submit(self._stage_shard, shard)
+            except RuntimeError:
+                if self.closed or retry:
+                    return None
+                self._replace_stagers(stagers)
+        return None
+
+    def _replace_stagers(self, observed) -> None:
+        """Swap the dead staging pool for a fresh one (counted).
+        Compare-and-swap under the lock against the pool the caller
+        OBSERVED failing: two stagers hitting the same dead pool
+        race here, and without the check the loser would replace the
+        winner's fresh pool — leaking it with its finalizer unbound
+        (stranded qt-stager threads)."""
+        from concurrent.futures import ThreadPoolExecutor
+        with self._lock:
+            if self._stagers is not observed or self.closed:
+                return               # someone already replaced/closed
+            old_fin = self._stagers_finalizer
+            pool = ThreadPoolExecutor(max_workers=self.workers,
+                                      thread_name_prefix="qt-stager")
+            self._stagers = pool
+            self._stagers_finalizer = weakref.finalize(
+                self, pool.shutdown, wait=False)
+            for vec in (self._io_undrained, self._io_total):
+                vec[5] += 1
+        old_fin.detach()
+        observed.shutdown(wait=False)
+
+    def _count_stager_restart(self) -> None:
+        with self._lock:
+            for vec in (self._io_undrained, self._io_total):
+                vec[5] += 1
 
     def _stage_shard(self, new: np.ndarray) -> int:
         """Read + decode + stage one shard of a publication's unique
@@ -390,6 +454,7 @@ class ColdPrefetcher:
         concurrent shards safe). The read goes through the deep-queue
         :class:`~quiver_tpu.io.ExtentReader` when the tier is a plain
         file region, else the mmap fancy-index compat path."""
+        faults.fire("prefetch.stager")
         f = self._feature
         reader = self._reader        # one read: close() may null it
         rows = None
@@ -408,6 +473,7 @@ class ColdPrefetcher:
                         vec[1] += io["rows"]
                         vec[2] += io["bytes"]
                         vec[3] = max(vec[3], io["depth_peak"])
+                        vec[4] += io.get("retries", 0)
         if rows is None:
             rows = np.asarray(f.mmap_array[new])         # compat read
         scale = zero = None
@@ -457,13 +523,23 @@ class ColdPrefetcher:
             with self._lock:
                 pending = [f for f in self._inflight if not f.done()]
                 self._inflight = pending
+            if pending:
+                # the pipeline worker may have DIED with these futures
+                # queued (injected pipeline.worker fault, escaped
+                # BaseException); the next submit would revive it, but
+                # this thread is about to BLOCK and may be the only
+                # one that would ever submit — revive it here
+                self._pipe.ensure_worker()
             for fut in pending:
                 if hit.all():
                     break
                 try:
-                    fut.result()
-                except Exception:   # cancelled/failed staging: go sync
-                    continue
+                    # bounded: a staging task wedged past any sane
+                    # disk time degrades to the sync read below —
+                    # counted, never wrong, never a deadlock
+                    fut.result(timeout=30.0)
+                except Exception:   # cancelled/failed/timed-out
+                    continue        # staging: go sync
                 miss_pos = np.flatnonzero(~hit)
                 sub = np.empty((miss_pos.shape[0],) + out.shape[1:],
                                out.dtype)
@@ -496,23 +572,28 @@ class ColdPrefetcher:
         ``cold_staged_rows_per_s`` (the interval's staging THROUGHPUT —
         the curve ``replan()``'s ``io_workers`` advisor reads),
         ``prefetch_truncated_rows`` (frontier rows dropped at an
-        undersized ring), and ``prefetch_drop_rate`` (publications
-        dropped at a saturated staging pipeline). Call it wherever the
-        loop already takes a breath (per epoch, per report); returns
-        the delta dict."""
+        undersized ring), ``prefetch_drop_rate`` (publications
+        dropped at a saturated staging pipeline), and
+        ``staging_worker_restarts`` (dead workers auto-replaced — a
+        DEFAULT_WATCHES spike series: the restart keeps serving, the
+        anomaly says look). Call it wherever the loop already takes a
+        breath (per epoch, per report); returns the delta dict."""
         t_now = time.monotonic()
         with self._lock:
             now = np.array([*(int(v) for v in self._counters),
                             self._published, self._dropped,
-                            self._truncated], np.int64)
+                            self._truncated,
+                            int(self._io_total[5])], np.int64)
             d = now - self._hub_last
             self._hub_last = now
             dt, self._hub_t = (None if self._hub_t is None
                                else t_now - self._hub_t), t_now
-        hit, sync, staged, pub, drop, trunc = (int(v) for v in d)
+        hit, sync, staged, pub, drop, trunc, restarts = \
+            (int(v) for v in d)
         out = {"hit_rows": hit, "sync_rows": sync, "staged_rows": staged,
                "published": pub, "dropped": drop,
-               "truncated_rows": trunc}
+               "truncated_rows": trunc,
+               "staging_worker_restarts": restarts}
         if hit + sync:
             hub.observe("prefetch_hit_rate", hit / (hit + sync))
         hub.observe("prefetch_staged_rows", staged)
@@ -523,6 +604,8 @@ class ColdPrefetcher:
             hub.observe("prefetch_truncated_rows", trunc)
         if pub:
             hub.observe("prefetch_drop_rate", drop / pub)
+        if restarts:
+            hub.observe("staging_worker_restarts", restarts)
         return out
 
     def drain_staged(self) -> int:
@@ -536,10 +619,11 @@ class ColdPrefetcher:
 
     def drain_io(self) -> np.ndarray:
         """IO facts since the last drain — ``[extents, rows_read,
-        bytes, depth_peak]`` int64 — the per-batch figures the metered
-        lookup writes into the ``io_*`` counter slots (the peak resets
-        each drain: it is a per-interval observation, merged with max
-        across steps by the slot semantics)."""
+        bytes, depth_peak, retries, stager_restarts]`` int64 — the
+        per-batch figures the metered lookup writes into the ``io_*``
+        / ``io_retries`` / ``staging_worker_restarts`` counter slots
+        (the peak resets each drain: it is a per-interval observation,
+        merged with max across steps by the slot semantics)."""
         with self._lock:
             vals = self._io_undrained.copy()
             self._io_undrained[:] = 0
@@ -555,8 +639,8 @@ class ColdPrefetcher:
             pub, drop, bat, trunc = (self._published, self._dropped,
                                      self._batches_staged,
                                      self._truncated)
-            io_ext, io_rows, io_bytes, io_peak = (
-                int(v) for v in self._io_total)
+            (io_ext, io_rows, io_bytes, io_peak, io_retries,
+             restarts) = (int(v) for v in self._io_total)
         total = hit + sync
         return {
             "published": pub, "dropped": drop, "batches_staged": bat,
@@ -565,11 +649,13 @@ class ColdPrefetcher:
             "hit_rate": (hit / total) if total else None,
             "capacity": self._ring.capacity, "filled": self._ring.filled,
             "workers": self.workers,
+            "staging_worker_restarts": restarts,
             "io": {
                 "engine": (self._reader.engine
                            if self._reader is not None else "mmap"),
                 "extents": io_ext, "rows_read": io_rows,
                 "bytes_read": io_bytes, "depth_peak": io_peak,
+                "retries": io_retries,
                 "coalescing_factor": coalescing_factor(io_rows, io_ext),
             },
             "pipeline": self._pipe.stats(),

@@ -73,6 +73,7 @@ the jitted serve program is bit-identical with tracing on or off
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -82,10 +83,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import tracing
+from . import faults, tracing
 from .parallel.train import (dedup_feature_gather, layers_to_adjs,
                              masked_feature_gather)
 from .profiling import hot_path
+# the typed request-failure vocabulary is shared with the RPC plane
+# (quiver_tpu.rpc defines it so the jax-free client can import it):
+# ServerClosed = "this replica will never answer; go elsewhere",
+# DeadlineExceeded = "the budget is spent; retrying cannot help"
+from .rpc import DeadlineExceeded, ServerClosed
+
+_log = logging.getLogger("quiver_tpu.serving")
 
 
 class OverloadError(RuntimeError):
@@ -398,15 +406,35 @@ class ServeConfig:
         self.pipeline_depth = int(pipeline_depth)
 
 
+def _fail_future(fut, exc) -> bool:
+    """Claim-and-fail one request future, tolerating a future some
+    OTHER path already resolved: ``submit``'s close-race handler and
+    ``close()``'s queue drain can both reach the same queued request
+    (the handler completes the future while the request still sits in
+    the queue the drain is about to sweep) — stdlib
+    ``set_running_or_notify_cancel`` RAISES on a finished future, so
+    the loser of that race must treat it as "already handled", not
+    crash ``close()``. Returns True when THIS call failed the
+    future."""
+    try:
+        claimed = fut.set_running_or_notify_cancel()
+    except RuntimeError:
+        return False                 # already resolved elsewhere
+    if claimed:
+        fut.set_exception(exc)
+    return claimed
+
+
 class _Request:
-    __slots__ = ("node_id", "future", "t_enq", "trace_id")
+    __slots__ = ("node_id", "future", "t_enq", "trace_id", "deadline")
 
     def __init__(self, node_id: int, future, t_enq: float,
-                 trace_id=None):
+                 trace_id=None, deadline: Optional[float] = None):
         self.node_id = node_id
         self.future = future
         self.t_enq = t_enq
         self.trace_id = trace_id
+        self.deadline = deadline
 
 
 class MicroBatchServer:
@@ -457,6 +485,10 @@ class MicroBatchServer:
                               name="quiver-serving-exec")
         self.stats.watch_pipeline(self._pipe)
         self._closed = False
+        # broken = the coalescer thread died UNEXPECTEDLY (not close):
+        # nothing will ever drain the queue again, so submissions must
+        # fail fast with ServerClosed instead of hanging on admission
+        self._broken = False
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         # shedding state (coalescer-thread only, except the counters)
@@ -464,6 +496,7 @@ class MicroBatchServer:
         self._calm = 0
         self._counts = {
             "requests": 0, "rejected": 0, "completed": 0, "failed": 0,
+            "deadline_expired": 0,
             "batches": 0, "coalesced": 0,
             "variant_batches": [0] * len(engine.variants),
         }
@@ -480,10 +513,10 @@ class MicroBatchServer:
     # -- life cycle ---------------------------------------------------------
     def start(self) -> "MicroBatchServer":
         with self._lock:
-            if self._closed:
-                raise RuntimeError("server is closed")
+            if self._closed or self._broken:
+                raise ServerClosed("server is closed")
             if self._thread is None:
-                t = threading.Thread(target=self._coalesce_loop,
+                t = threading.Thread(target=self._coalesce_guard,
                                      name="quiver-serving-coalescer",
                                      daemon=True)
                 t.start()
@@ -528,12 +561,25 @@ class MicroBatchServer:
         return self._closed
 
     # -- admission ----------------------------------------------------------
-    def submit(self, node_id: int, context=None):
+    def submit(self, node_id: int, context=None,
+               deadline: Optional[float] = None):
         """Admit one point query; returns a ``Future`` resolving to the
         node's logits row (numpy ``[out_dim]``). Raises
         :class:`OverloadError` IMMEDIATELY when the admission queue is
         full — rejecting at the door is the overload policy's last
-        stage (see :class:`ServeConfig`).
+        stage (see :class:`ServeConfig`) — and
+        :class:`~quiver_tpu.rpc.ServerClosed` when the server is
+        closed OR its coalescer thread died (the thread-death watchdog:
+        a request that nothing will ever drain must fail fast, never
+        hang on the admission queue).
+
+        ``deadline`` (absolute ``time.perf_counter()`` instant — the
+        RPC front end converts its wire budget) arms per-request
+        deadline shedding: a request whose deadline passes while it
+        waits is failed with
+        :class:`~quiver_tpu.rpc.DeadlineExceeded` at coalesce time,
+        BEFORE it wastes a seed slot in a batch the client has already
+        given up on.
 
         ``context`` is optional request metadata carrying a propagated
         trace context (``tracing.inject`` on the client side): when
@@ -542,8 +588,10 @@ class MicroBatchServer:
         and this replica's exported traces correlate in one merged
         Perfetto view (``tracing.merge_chrome_traces``). A missing or
         mangled context falls back to a local id — never an error."""
-        if self._closed:
-            raise RuntimeError("server is closed")
+        if self._closed or self._broken:
+            raise ServerClosed("server is closed"
+                               if self._closed else
+                               "server is broken (coalescer died)")
         from concurrent.futures import Future
         fut: Future = Future()
         tid = None
@@ -552,7 +600,8 @@ class MicroBatchServer:
                 else None
             tid = ctx.trace_id if ctx is not None \
                 else tracing.new_trace_id()
-        req = _Request(int(node_id), fut, time.perf_counter(), tid)
+        req = _Request(int(node_id), fut, time.perf_counter(), tid,
+                       deadline)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -565,20 +614,21 @@ class MicroBatchServer:
             raise OverloadError(
                 f"admission queue full ({self.config.queue_depth} "
                 "pending); request shed") from None
-        if self._closed:
-            # close() raced us: its drain may have run before our put
-            # landed, and no coalescer will ever pop the request —
-            # reclaim it so the future cannot strand (the claim is
-            # exclusive, so if close's drain got there first this is a
-            # no-op and the future is already failed)
-            if req.future.set_running_or_notify_cancel():
-                req.future.set_exception(RuntimeError("server is closed"))
-            raise RuntimeError("server is closed")
+        if self._closed or self._broken:
+            # close() (or the coalescer-death watchdog) raced us: its
+            # drain may have run before our put landed, and no
+            # coalescer will ever pop the request — reclaim it so the
+            # future cannot strand (the claim is exclusive, so if the
+            # drain got there first this is a no-op and the future is
+            # already failed)
+            _fail_future(req.future, ServerClosed("server is closed"))
+            raise ServerClosed("server is closed")
         with self._counts_lock:
             self._counts["requests"] += 1
         return fut
 
-    def submit_many(self, node_ids, context=None) -> list:
+    def submit_many(self, node_ids, context=None,
+                    deadline: Optional[float] = None) -> list:
         """``submit`` per id (one shared ``context`` — a multi-point
         client operation traces as ONE request id across its points).
         If admission overloads mid-list the raised
@@ -588,20 +638,66 @@ class MicroBatchServer:
         futs: list = []
         for i in node_ids:
             try:
-                futs.append(self.submit(i, context=context))
+                futs.append(self.submit(i, context=context,
+                                        deadline=deadline))
             except OverloadError as e:
                 e.futures = futs
                 raise
         return futs
 
     # -- coalescing ---------------------------------------------------------
+    def _coalesce_guard(self):
+        """The coalescer's thread-death watchdog: any exception
+        escaping the loop (an injected ``serve.coalesce`` fault, a bug)
+        marks the server BROKEN, fails every queued future with
+        ``ServerClosed`` immediately — a dead coalescer means nothing
+        will ever drain the queue, and a fast typed failure beats a
+        silent hang — then re-raises so the death stays visible."""
+        try:
+            self._coalesce_loop()
+        except BaseException as e:
+            if self._closed:
+                raise
+            self._broken = True
+            _log.error("serving coalescer died unexpectedly (%s: %s); "
+                       "failing queued requests with ServerClosed",
+                       type(e).__name__, e)
+            undispatched = []
+            while True:
+                try:
+                    undispatched.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            self._fail_batch(undispatched,
+                             "coalescer thread died; server is broken",
+                             exc_type=ServerClosed)
+            raise
+
+    def _shed_expired(self, req) -> bool:
+        """Fail ``req`` with DeadlineExceeded if its deadline already
+        passed — BEFORE it costs a batch seed slot. Returns True when
+        the request was shed (or already claimed elsewhere)."""
+        if req.deadline is None or time.perf_counter() <= req.deadline:
+            return False
+        if _fail_future(req.future, DeadlineExceeded(
+                "deadline passed while queued (shed at coalesce — the "
+                "client has already given up on this request)")):
+            if self.slo is not None:
+                self.slo.record(ok=False)
+            with self._counts_lock:
+                self._counts["deadline_expired"] += 1
+        return True
+
     def _coalesce_loop(self):
         max_wait = self.config.max_wait_ms / 1e3
         cap = self.engine.batch_cap
         while not self._closed:
+            faults.fire("serve.coalesce")
             try:
                 first = self._q.get(timeout=0.02)
             except queue.Empty:
+                continue
+            if self._shed_expired(first):
                 continue
             # span plumbing: one enabled-check per batch when tracing is
             # off; when on, each request gets admission_wait (queue time
@@ -631,6 +727,8 @@ class MicroBatchServer:
                     req = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                if self._shed_expired(req):
+                    continue
                 batch.append(req)
                 slots.setdefault(req.node_id, len(slots))
                 if traced:
@@ -705,15 +803,18 @@ class MicroBatchServer:
 
     # -- execution + scatter ------------------------------------------------
     def _fail_batch(self, batch, msg: str = "server closed before "
-                                            "dispatch"):
-        """Fail every not-yet-claimed future in ``batch`` loudly. The
-        claim (``set_running_or_notify_cancel``) is exclusive, so this
-        composes race-free with ``_execute`` and caller-side
-        ``cancel()``."""
+                                            "dispatch",
+                    exc_type=ServerClosed):
+        """Fail every not-yet-claimed future in ``batch`` loudly (with
+        a TYPED error — ``ServerClosed`` subclasses RuntimeError, so a
+        retrying RPC client can route elsewhere while legacy callers
+        still catch it). The claim (``set_running_or_notify_cancel``)
+        is exclusive, so this composes race-free with ``_execute`` and
+        caller-side ``cancel()``; a future ``submit``'s close-race
+        handler already failed counts as handled (``_fail_future``)."""
         failed = 0
         for req in batch:
-            if req.future.set_running_or_notify_cancel():
-                req.future.set_exception(RuntimeError(msg))
+            if _fail_future(req.future, exc_type(msg)):
                 failed += 1
         if failed:
             if self.slo is not None:
@@ -732,6 +833,7 @@ class MicroBatchServer:
             return
         t0 = time.perf_counter()
         try:
+            faults.fire("serve.execute")
             logits = self.engine.run(seeds, variant)
             rows = np.asarray(jax.device_get(logits))
         except BaseException as e:
@@ -803,6 +905,10 @@ class MicroBatchServer:
         only an outside observer can judge. Returns ``{"score",
         "components"}``."""
         from .fleet import health_score
+        if getattr(self, "_broken", False):
+            # a dead coalescer serves nothing: the self-report agrees
+            # with what the fleet will conclude from staleness
+            return {"score": 0.0, "components": {"broken": True}}
         burn = None
         if self.slo is not None:
             s = self.slo.burn_rate(self.slo.short_window_s)

@@ -283,6 +283,10 @@ DEFAULT_WATCHES = (
     ("dup_factor", "page_hinkley", {"delta": 0.05, "threshold": 1.0}),
     ("prefetch_hit_rate", "mean_shift", {"direction": "down"}),
     ("recompiles", "spike", {}),
+    # a staging worker dying at all is an incident worth a record —
+    # the auto-replacement keeps serving, the spike says LOOK (fed by
+    # ColdPrefetcher.observe_into; qt-chaos's injector exercises it)
+    ("staging_worker_restarts", "spike", {}),
     # a stage silently growing its share of the step (the profiler's
     # stage_share:<entry>/<stage> series — a trailing * is a PREFIX
     # watch, armed lazily on every matching series as it appears)

@@ -157,8 +157,33 @@ def is_skipped(rec):
 #: random-gather peak, a 0..1 fraction) joins in round 14 — a stage
 #: drifting away from the hardware's limits fails the sweep even when
 #: absolute rows/s still looks plausible on a faster box.
+#: ``chaos_*`` (qt-chaos's resilience figures from
+#: ``bench_serving.py --chaos-only``) join in round 16 — these are
+#: LOWER-is-better (see ``INVERTED_METRICS``): accepted-p99 ratio
+#: under a seeded kill, typed-error rate, kill->staleness detection
+#: latency, kill->serving-again recovery time.
 SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
-               "cold_staged_rows_per_s", "gather_efficiency")
+               "cold_staged_rows_per_s", "gather_efficiency",
+               "chaos_accepted_p99_ratio", "chaos_error_rate",
+               "chaos_detection_s", "chaos_recovery_s")
+
+#: trajectory groups where LOWER is better: "best prior" is the
+#: minimum, and the regression rule inverts — the latest value more
+#: than ``threshold`` ABOVE the best prior (plus the metric's
+#: absolute slack) fails the sweep.
+INVERTED_METRICS = ("chaos_accepted_p99_ratio", "chaos_error_rate",
+                    "chaos_detection_s", "chaos_recovery_s")
+
+#: per-metric absolute slack for the inverted rule: several of these
+#: bottom out at 0.0 (a chaos run with EVERY request recovered records
+#: error rate 0), where a purely multiplicative threshold is
+#: degenerate — any nonzero later value would "regress". The slack is
+#: the noise floor a healthy run may sit inside; a drift past
+#: best*(1+threshold)+slack is a real degradation on this box.
+INVERTED_ABS_SLACK = {"chaos_error_rate": 0.02,
+                      "chaos_detection_s": 0.5,
+                      "chaos_recovery_s": 2.0,
+                      "chaos_accepted_p99_ratio": 0.75}
 
 
 def _points(rec):
@@ -193,7 +218,9 @@ def _walk(records):
             prev = latest.get(key)
             if prev is not None:
                 prior = best.get(key)
-                if prior is None or prev[0] > prior[0]:
+                lower = metric in INVERTED_METRICS
+                if prior is None or (prev[0] < prior[0] if lower
+                                     else prev[0] > prior[0]):
                     best[key] = prev
             latest[key] = (value, label)
     return best, latest, checked
@@ -210,17 +237,27 @@ def verdicts(records, threshold):
     out = []
     for key, (value, label) in sorted(latest.items()):
         prior = best.get(key)
+        lower = key[0] in INVERTED_METRICS
+        if lower:
+            slack = INVERTED_ABS_SLACK.get(key[0], 0.0)
+            regressed = bool(prior and value >
+                             (1.0 + threshold) * prior[0] + slack)
+        else:
+            regressed = bool(prior
+                             and value < (1.0 - threshold) * prior[0])
         v = {
             "metric": key[0], "platform": key[1] or "default",
             "value": value, "run": label,
             "best": prior[0] if prior else None,
             "best_run": prior[1] if prior else None,
             "ratio": (value / prior[0] if prior and prior[0] else None),
-            "regressed": bool(prior
-                              and value < (1.0 - threshold) * prior[0]),
+            "direction": "lower" if lower else "higher",
+            "regressed": regressed,
         }
         if prior:
-            v["drop_frac"] = 1.0 - value / prior[0]
+            v["drop_frac"] = ((value / prior[0] - 1.0) if lower
+                              else 1.0 - value / prior[0]) \
+                if prior[0] else None
         out.append(v)
     return out, checked
 
@@ -275,9 +312,12 @@ def main(argv=None):
           f"({skipped} skipped/unavailable rounds ignored), "
           f"threshold {args.threshold:.0%}")
     for r in regressions:
+        word = "above" if r["direction"] == "lower" else "below"
+        frac = ("" if r.get("drop_frac") is None
+                else f"{r['drop_frac']:.1%} ")
         print(f"REGRESSION {r['metric']} [{r['platform']}]: "
-              f"{r['value']:.1f} in {r['run']} is {r['drop_frac']:.1%} "
-              f"below best {r['best']:.1f} ({r['best_run']})")
+              f"{r['value']:.3f} in {r['run']} is {frac}"
+              f"{word} best {r['best']:.3f} ({r['best_run']})")
     emit_path = args.emit_jsonl or args.jsonl
     if emit_path:
         try:

@@ -84,6 +84,15 @@ of donated states are transient). The profiler is a separate pass by
 construction; this phase is what makes "by construction" a measured
 fact.
 
+Phase 11 pins the FAULT layer (qt-chaos): with a seeded ``FaultPlan``
+ACTIVELY injecting transient storage errors, slow reads, and a
+staging-worker death, 30 prefetched cold-tier lookups + 30 served
+requests must grow zero executables and zero recompiles — every
+degradation path (retry, per-extent mmap fallback, sync read,
+shard-retry) reuses already-compiled programs, and the injections are
+counted (``io_retries`` / ``faults_injected`` /
+``staging_worker_restarts`` slots), never silent.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -827,6 +836,109 @@ def main():
     prof_sink.close()
     print("no leak detected (phase 10: full qt-prof pass over warmed "
           "entries — flat executables, flat arrays)")
+
+    # ---- phase 11: an ACTIVE storage-fault plan is still free ----
+    # Chaos must not cost compiles: with a seeded FaultPlan injecting
+    # transient read errors (retry ladder), slow reads, and one
+    # staging-worker death into the cold-tier path, 30 prefetched
+    # lookups + 30 served requests must grow ZERO executables and
+    # ZERO recompiles — the fault layer lives entirely on host control
+    # paths, and every degradation (retry, mmap fallback, sync read)
+    # reuses already-compiled programs.
+    from quiver_tpu import faults as qfaults
+
+    ftmp = tempfile.mkdtemp(prefix="qt_leak_faults_")
+    ffeat = rng.standard_normal((8_000, 16)).astype(np.float32)
+    save_disk_tier(ffeat, np.arange(8_000, dtype=np.int64), ftmp,
+                   dtype_policy="int8")
+    fstore, _fmeta = load_disk_tier_store(ftmp, hot_rows=4_000,
+                                          prefetch_rows=1_024,
+                                          workers=2, io_qd=4)
+    fcompute = jax.jit(lambda x: jnp.sum(jnp.tanh(x)))
+    fstats = qm.StepStats(fold_every=8)
+
+    def fault_batch():
+        return np.concatenate([
+            rng.integers(4_000, 8_000, 256),
+            rng.integers(0, 4_000, 256)]).astype(np.int64)
+
+    fb = [fault_batch() for _ in range(2)]
+    fstore.stage_frontier(fb[0])
+    rows0, _ = fstore.lookup_tiered(fb[0], collect_metrics=True)
+    jax.block_until_ready(fcompute(rows0))
+    # pre-fault ground truth for the post-chaos correctness replay
+    check_ids = fb[0]
+    want = np.asarray(jax.device_get(fstore[check_ids]))
+    fserver = MicroBatchServer(engine, ServeConfig(max_wait_ms=1.0))
+    for f in [fserver.submit(int(i)) for i in rng.integers(0, n, 10)]:
+        f.result(timeout=60)
+    fstats.watch_compiles(fstore._gather_cached, fcompute,
+                          *engine.jitted_fns)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = (fstore._gather_cached._cache_size()
+                  + fcompute._cache_size()
+                  + sum(f._cache_size() for f in engine.jitted_fns))
+
+    qfaults.install(qfaults.FaultPlan(seed=13, rules={
+        "io.read": qfaults.FaultRule("error", errno_name="EINTR",
+                                     rate=0.3),
+        "io.slow": qfaults.FaultRule("delay", delay_ms=1.0, rate=0.2),
+        "prefetch.stager": qfaults.FaultRule("error", exc="runtime",
+                                             times=1),
+    }))
+    try:
+        ids_next = fault_batch()
+        fstore.stage_frontier(ids_next)
+        for i in range(30):
+            ids_now, ids_next = ids_next, fault_batch()
+            rows, counters = fstore.lookup_tiered(ids_now,
+                                                  collect_metrics=True)
+            fstore.stage_frontier(ids_next)
+            jax.block_until_ready(fcompute(rows))
+            fstats.add_counters(counters)
+        sfuts = [fserver.submit(int(i))
+                 for i in rng.integers(0, n, 30)]
+        for f in sfuts:
+            assert np.isfinite(f.result(timeout=60)).all()
+        injected = qfaults.active().injected
+    finally:
+        qfaults.disarm()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = (fstore._gather_cached._cache_size()
+            + fcompute._cache_size()
+            + sum(f._cache_size() for f in engine.jitted_fns)) \
+        - base_cache
+    fsnap = fstats.snapshot()
+    fc = fsnap["counters"]
+    print(f"phase 11 live arrays: {base_arrays} -> {arrays}; "
+          f"faulted-loop executable-cache growth: {grew}; "
+          f"recompiles: {fsnap['recompiles']}; faults injected: "
+          f"{injected}; io_retries: {fc['io_retries']}, "
+          f"staging_worker_restarts: {fc['staging_worker_restarts']}")
+    assert injected > 0, \
+        "phase premise: the armed plan must actually fire"
+    assert fc["io_retries"] > 0, \
+        "phase premise: the retry ladder must be exercised"
+    assert fc["faults_injected"] > 0, \
+        "the faults_injected slot never drained the plan's count"
+    assert grew == 0, "an active fault plan compiled something"
+    assert fsnap["recompiles"] == 0, \
+        "recompile watch fired under the fault plan"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak under the storage-fault plan"
+    # the degraded reads stayed CORRECT: the post-chaos replay must
+    # equal the PRE-fault ground truth captured before arming (a
+    # faulted path corrupting ring/store state would poison both
+    # sides of a read-it-twice check)
+    got = np.asarray(jax.device_get(fstore[check_ids]))
+    np.testing.assert_array_equal(want, got)
+    fserver.close()
+    fstore.close()
+    shutil.rmtree(ftmp, ignore_errors=True)
+    print("no leak detected (phase 11: active storage-fault plan — "
+          "flat executables, zero recompiles, faults counted)")
 
 
 if __name__ == "__main__":

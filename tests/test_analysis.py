@@ -400,6 +400,69 @@ class TestSeededHostViolations:
                "    return np.asarray(rows)\n")
         assert host_lint.check_source(src) == []
 
+    def test_swallowed_worker_exception(self):
+        # the class the fault injector keeps finding: a worker loop's
+        # over-broad except that neither counts, logs, nor re-raises
+        src = ("def worker(q):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            q.get()\n"
+               "        except Exception:\n"
+               "            continue\n")
+        findings = host_lint.check_source(src, "seeded.py")
+        assert [f.rule for f in findings] == \
+            ["swallowed_worker_exception"]
+        bare = ("def worker(q):\n"
+                "    while True:\n"
+                "        try:\n"
+                "            q.get()\n"
+                "        except:\n"
+                "            pass\n")
+        assert [f.rule for f in host_lint.check_source(bare)] == \
+            ["swallowed_worker_exception"]
+
+    def test_swallow_that_counts_logs_or_reraises_is_clean(self):
+        counts = ("def worker(q, stats):\n"
+                  "    while True:\n"
+                  "        try:\n"
+                  "            q.get()\n"
+                  "        except Exception:\n"
+                  "            stats['errors'] += 1\n")
+        logs = ("import logging\n"
+                "def worker(q):\n"
+                "    while True:\n"
+                "        try:\n"
+                "            q.get()\n"
+                "        except Exception:\n"
+                "            logging.exception('worker step failed')\n")
+        reraises = ("def worker(q):\n"
+                    "    while True:\n"
+                    "        try:\n"
+                    "            q.get()\n"
+                    "        except Exception:\n"
+                    "            raise\n")
+        narrow = ("import queue\n"
+                  "def worker(q):\n"
+                  "    while True:\n"
+                  "        try:\n"
+                  "            q.get_nowait()\n"
+                  "        except queue.Empty:\n"
+                  "            continue\n")
+        outside_loop = ("def once(q):\n"
+                        "    try:\n"
+                        "        q.get()\n"
+                        "    except Exception:\n"
+                        "        pass\n")
+        bounded_for = ("def sweep(procs):\n"
+                       "    for p in procs:\n"
+                       "        try:\n"
+                       "            p.kill()\n"
+                       "        except Exception:\n"
+                       "            pass\n")
+        for src in (counts, logs, reraises, narrow, outside_loop,
+                    bounded_for):
+            assert host_lint.check_source(src) == [], src
+
 
 # ---------------------------------------------------------------------------
 # clean pass over the real tree + registry

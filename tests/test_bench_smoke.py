@@ -198,6 +198,18 @@ def test_bench_serving_smoke_json_contract(tmp_path):
     agree = out["fanout_argmax_agreement"]
     assert set(agree) == {"[10, 5]", "[4, 2]", "[2, 1]"}
     assert all(0.0 <= v <= 1.0 for v in agree.values())
+    # the chaos kill A/B ran (smoke: jax-free fake replicas): the
+    # victim died by the seeded plan, was restarted, nothing lost
+    ch = out["chaos_ab"]
+    assert ch["clean"]["accepted"] == ch["clean"]["requests"]
+    assert ch["chaos"]["victim_restarts"] >= 1
+    assert ch["chaos"]["accepted"] + sum(
+        ch["chaos"]["errors"].values()) == ch["chaos"]["requests"]
+    assert ch["chaos_error_rate"] <= 0.05
+    assert ch["chaos_recovery_s"] is not None
+    # the fake-fleet numbers stay NESTED: the tracked chaos_*
+    # trajectory keys must come only from real-replica runs
+    assert "chaos_detection_s" not in out
     # mirrored into the structured metrics log with the shared schema
     with open(sink_path) as f:
         recs = [json.loads(l) for l in f if l.strip()]

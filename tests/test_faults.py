@@ -1,0 +1,534 @@
+"""Chaos matrix — every named fault site fires under a seeded
+``FaultPlan`` and the system's DOCUMENTED degradation holds.
+
+The contract per site (quiver_tpu/faults.py):
+
+- ``io.read`` / ``io.slow`` — the extent reader retries transient
+  errors, falls back to a per-extent mmap read, and the result stays
+  bit-identical to ``mmap[rows]``; a permanently failing path raises
+  loudly naming the extent, never returns short rows;
+- ``prefetch.stager`` — a dead staging worker fails the publication;
+  lookups fall back to the synchronous read (counted as
+  ``prefetch_sync_rows``), gathers bit-identical; a one-off failure is
+  retried inline and counted in ``staging_worker_restarts``;
+- ``pipeline.worker`` — a dead worker thread is restarted by the
+  watchdog with every queued future intact;
+- ``sink.write`` — a failing telemetry disk never kills the data path
+  (counted in ``write_errors``);
+- ``serve.execute`` — the batch's futures see the exception, the
+  server stays serviceable;
+- ``serve.coalesce`` — a dead coalescer fails queued futures with
+  ``ServerClosed`` FAST and rejects new submissions (never a hang).
+
+Plus the no-faults-armed pin: with a plan armed at rate 0, gathers
+and serve logits are bit-identical to the disarmed run and the jitted
+paths stay at zero host syncs — the fault layer never enters a jitted
+program.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu import faults as qfaults
+from quiver_tpu import metrics as qm
+from quiver_tpu.faults import FaultPlan, FaultRule
+from quiver_tpu.io import ExtentReader
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops import quant, sample_multihop
+from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                       masked_feature_gather)
+from quiver_tpu.partition import load_disk_tier, save_disk_tier
+
+from _traffic import host_sync_eqns
+
+N, DIM, CACHE = 480, 12, 160
+SN, SDIM, CLASSES, CAP = 300, 8, 3, 8
+FULL, SHED = [4, 4], [1, 1]
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No test leaks an armed plan into the next."""
+    yield
+    qfaults.disarm()
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    rng = np.random.default_rng(3)
+    feat = rng.standard_normal((N, DIM)).astype(np.float32)
+    d = str(tmp_path_factory.mktemp("chaos_cold") / "disk")
+    save_disk_tier(feat, np.arange(N, dtype=np.int64), d,
+                   dtype_policy="int8")
+    kwargs, meta = load_disk_tier(d)
+    return d, kwargs, meta, feat
+
+
+def decoded_reference(kwargs):
+    tier = quant.QuantizedTensor(
+        np.load(kwargs["path"], mmap_mode="r"),
+        np.load(kwargs["scale"]), np.load(kwargs["zero"]))
+    return np.asarray(quant.take_np(tier, np.arange(N)))
+
+
+def make_store(kwargs, prefetch=None, workers=1):
+    ref = decoded_reference(kwargs)
+    f = qv.Feature()
+    f.from_mmap(None, qv.DeviceConfig([ref[:CACHE]], None))
+    f.set_mmap_file(**kwargs)
+    if prefetch:
+        f.enable_cold_prefetch(prefetch, workers=workers)
+    return f
+
+
+@pytest.fixture(scope="module")
+def serve_world():
+    """Tiny deterministic serving world (max degree < fanout, so
+    full-fanout logits are key-independent — the test_serving
+    construction)."""
+    rng = np.random.default_rng(11)
+    deg = rng.integers(1, 4, SN)
+    indptr = np.zeros(SN + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, SN, int(indptr[-1]), dtype=np.int32)
+    feat = rng.standard_normal((SN, SDIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=8, out_dim=CLASSES, num_layers=2,
+                      dropout=0.0)
+    ij = jnp.asarray(indptr.astype(np.int32))
+    xj = jnp.asarray(indices)
+    n_id, layers = sample_multihop(ij, xj,
+                                   jnp.arange(4, dtype=jnp.int32),
+                                   FULL, jax.random.key(0))
+    state = init_state(model, optax.adam(1e-3),
+                       masked_feature_gather(jnp.asarray(feat), n_id),
+                       layers_to_adjs(layers, 4, FULL),
+                       jax.random.key(1))
+    return model, state.params, ij, xj, feat
+
+
+@pytest.fixture(scope="module")
+def engine(serve_world):
+    model, params, ij, xj, feat = serve_world
+    return qv.ServeEngine(model, params, (ij, xj), feat,
+                          sizes_variants=[FULL, SHED],
+                          batch_cap=CAP).warmup()
+
+
+# ---------------------------------------------------------------------------
+# the plan itself: seeded, deterministic, serializable
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def _fire_pattern(self, plan, site, n=200):
+        hits = []
+        for i in range(n):
+            try:
+                plan.check(site)
+            except OSError:
+                hits.append(i)
+        return hits
+
+    def test_seeded_rate_is_deterministic(self):
+        mk = lambda: FaultPlan(seed=7, rules={
+            "io.read": FaultRule("error", rate=0.3)})
+        a = self._fire_pattern(mk(), "io.read")
+        b = self._fire_pattern(mk(), "io.read")
+        assert a == b and len(a) > 20
+        # a different seed fires a different pattern
+        c = self._fire_pattern(FaultPlan(seed=8, rules={
+            "io.read": FaultRule("error", rate=0.3)}), "io.read")
+        assert a != c
+
+    def test_after_and_times_are_exact(self):
+        plan = FaultPlan(rules={"io.read": FaultRule(
+            "error", after=5, times=2)})
+        hits = self._fire_pattern(plan, "io.read", n=20)
+        assert hits == [5, 6]
+        assert plan.injected == 2
+        assert plan.counts()["io.read"] == {"checks": 20, "fires": 2}
+
+    def test_rate_zero_never_fires(self):
+        plan = FaultPlan(rules={s: FaultRule("error", rate=0.0)
+                                for s in qfaults.SITES})
+        for s in qfaults.SITES:
+            for _ in range(50):
+                plan.check(s)
+        assert plan.injected == 0
+
+    def test_every_site_is_armable_and_fires(self):
+        for site in qfaults.SITES:
+            plan = FaultPlan(rules={site: FaultRule("error",
+                                                    exc="runtime")})
+            with pytest.raises(RuntimeError, match=site):
+                plan.check(site)
+
+    def test_unknown_site_and_bad_spec_raise(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultPlan(rules={"nope.site": FaultRule()})
+        with pytest.raises(ValueError):
+            qfaults.parse_spec("io.read")          # no kind
+        with pytest.raises(ValueError):
+            qfaults.parse_spec("io.read:explode")  # unknown kind
+        with pytest.raises(ValueError):
+            qfaults.parse_spec("io.read:error,wat=1")
+
+    def test_spec_round_trip_and_env(self):
+        plan = FaultPlan(seed=9, rules={
+            "io.read": FaultRule("error", errno_name="EINTR",
+                                 rate=0.5, times=3),
+            "rpc.request": FaultRule("kill", after=40)})
+        again = qfaults.parse_spec(plan.spec(), seed=9)
+        assert again.spec() == plan.spec()
+        env = plan.env()
+        got = qfaults.plan_from_env(env)
+        assert got is not None and got.seed == 9
+        assert got.spec() == plan.spec()
+        assert qfaults.plan_from_env({}) is None
+
+    def test_install_fire_drain_and_chaos_record(self, tmp_path):
+        plan = qfaults.install(FaultPlan(rules={
+            "sink.write": FaultRule("error", times=1)}))
+        try:
+            with pytest.raises(OSError):
+                qfaults.fire("sink.write")
+            qfaults.fire("sink.write")             # times=1: spent
+            assert qfaults.drain_injected() == 1
+            assert qfaults.drain_injected() == 0
+        finally:
+            qfaults.disarm()
+        qfaults.fire("sink.write")                 # disarmed: no-op
+        sink = qm.MetricsSink(str(tmp_path / "c.jsonl"))
+        rec = plan.emit(sink)
+        sink.close()
+        assert rec["kind"] == "chaos" and rec["injected"] == 1
+        assert rec["sites"]["sink.write"]["fires"] == 1
+
+    def test_delay_kind_sleeps_and_continues(self):
+        plan = FaultPlan(rules={"io.slow": FaultRule("delay",
+                                                     delay_ms=20.0)})
+        t0 = time.perf_counter()
+        plan.check("io.slow")                      # returns, no raise
+        assert time.perf_counter() - t0 >= 0.015
+
+
+# ---------------------------------------------------------------------------
+# io.read / io.slow: the extent reader's resilience ladder
+# ---------------------------------------------------------------------------
+
+
+class TestIoFaults:
+    def _reader(self, kwargs, **kw):
+        mm = np.load(kwargs["path"], mmap_mode="r")
+        r = ExtentReader.from_array(mm, **kw)
+        assert r is not None
+        return mm, r
+
+    def test_transient_errors_retry_bit_identical(self, artifact):
+        _, kwargs, _, _ = artifact
+        mm, r = self._reader(kwargs, qd=4)
+        rows = np.arange(0, N, 3, dtype=np.int64)
+        qfaults.install(FaultPlan(seed=5, rules={
+            "io.read": FaultRule("error", errno_name="EINTR",
+                                 rate=0.5)}))
+        try:
+            out, stats = r.read_rows(rows)
+        finally:
+            qfaults.disarm()
+            r.close()
+        np.testing.assert_array_equal(out, np.asarray(mm[rows]))
+        # rate 0.5 over many extents: some retried, some fell back —
+        # every outcome still exact
+        assert stats["retries"] + stats["fallback_extents"] > 0
+
+    def test_exhausted_retries_fall_back_per_extent(self, artifact):
+        _, kwargs, _, _ = artifact
+        mm, r = self._reader(kwargs, qd=4)
+        rows = np.arange(0, 120, dtype=np.int64)
+        qfaults.install(FaultPlan(rules={
+            "io.read": FaultRule("error", errno_name="EIO")}))  # always
+        try:
+            out, stats = r.read_rows(rows)
+        finally:
+            qfaults.disarm()
+            r.close()
+        np.testing.assert_array_equal(out, np.asarray(mm[rows]))
+        assert stats["fallback_extents"] == stats["extents"] > 0
+        from quiver_tpu.io import IO_READ_RETRIES
+        assert stats["retries"] == stats["extents"] * IO_READ_RETRIES
+
+    def test_permanent_failure_raises_naming_the_extent(self, artifact,
+                                                        tmp_path):
+        _, kwargs, _, _ = artifact
+        _, r = self._reader(kwargs, qd=2)
+        # make the mmap fallback unusable too: point the reader at a
+        # path that no longer exists (the permanently-dead-fd shape)
+        r._mm = None
+        r.path = str(tmp_path / "gone.npy")
+        qfaults.install(FaultPlan(rules={
+            "io.read": FaultRule("error", errno_name="EIO")}))
+        try:
+            with pytest.raises(OSError, match=r"extent \(start_row="):
+                r.read_rows(np.arange(40, dtype=np.int64))
+        finally:
+            qfaults.disarm()
+            r.close()
+
+    def test_slow_reads_stay_correct(self, artifact):
+        _, kwargs, _, _ = artifact
+        mm, r = self._reader(kwargs, qd=4)
+        rows = np.arange(0, 60, 2, dtype=np.int64)
+        qfaults.install(FaultPlan(rules={
+            "io.slow": FaultRule("delay", delay_ms=2.0, rate=0.5)}))
+        try:
+            out, _ = r.read_rows(rows)
+        finally:
+            qfaults.disarm()
+            r.close()
+        np.testing.assert_array_equal(out, np.asarray(mm[rows]))
+
+
+# ---------------------------------------------------------------------------
+# prefetch.stager: staging-worker death
+# ---------------------------------------------------------------------------
+
+
+class TestStagerFaults:
+    def test_dead_stagers_degrade_to_sync_counted(self, artifact):
+        _, kwargs, _, _ = artifact
+        ref_store = make_store(kwargs)
+        store = make_store(kwargs, prefetch=256, workers=2)
+        ids = np.arange(CACHE - 20, N, dtype=np.int64)
+        qfaults.install(FaultPlan(rules={
+            "prefetch.stager": FaultRule("error", exc="runtime")}))
+        try:
+            fut = store.stage_frontier(ids)
+            if fut is not None:
+                with pytest.raises(RuntimeError):
+                    fut.result(timeout=30)
+            got = np.asarray(store[jnp.asarray(ids)])
+        finally:
+            qfaults.disarm()
+        want = np.asarray(ref_store[jnp.asarray(ids)])
+        np.testing.assert_array_equal(got, want)
+        pf = store._cold_prefetch
+        s = pf.stats()
+        # nothing staged; every cold row was a counted sync fallback
+        assert s["hit_rows"] == 0 and s["sync_rows"] > 0
+        store.close()
+        ref_store.close()
+
+    def test_single_shard_failure_retries_and_counts(self, artifact):
+        _, kwargs, _, _ = artifact
+        store = make_store(kwargs, prefetch=256, workers=2)
+        ids = np.arange(CACHE, CACHE + 120, dtype=np.int64)
+        qfaults.install(FaultPlan(rules={
+            "prefetch.stager": FaultRule("error", exc="runtime",
+                                         times=1)}))
+        try:
+            fut = store.stage_frontier(ids)
+            assert fut is not None
+            staged = fut.result(timeout=30)
+        finally:
+            qfaults.disarm()
+        assert staged == 120                 # the retry staged them all
+        pf = store._cold_prefetch
+        assert pf.stats()["staging_worker_restarts"] >= 1
+        ref = decoded_reference(kwargs)
+        got = np.asarray(store[jnp.asarray(ids)])
+        np.testing.assert_array_equal(got, ref[ids])
+        # and the restart rode the drained io vector into the slots
+        assert int(pf.drain_io()[5]) >= 1
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# pipeline.worker: thread death + watchdog restart
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineWorkerDeath:
+    def test_worker_death_restarts_with_futures_intact(self):
+        p = qv.Pipeline(depth=4, name="chaos-pipe")
+        qfaults.install(FaultPlan(rules={
+            "pipeline.worker": FaultRule("error", exc="runtime",
+                                         times=1)}))
+        try:
+            f1 = p.submit(lambda: 41)
+            # the injected death happens at the loop top, before the
+            # item is claimed — wait for the thread to die
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                t = p._box["thread"]
+                if t is None or not t.is_alive():
+                    break
+                time.sleep(0.01)
+            f2 = p.submit(lambda: 42)      # revives the worker
+            assert f1.result(timeout=10) == 41
+            assert f2.result(timeout=10) == 42
+        finally:
+            qfaults.disarm()
+        assert p.stats()["worker_restarts"] == 1
+        assert p.stats()["completed"] == 2
+        p.close()
+
+
+# ---------------------------------------------------------------------------
+# sink.write: telemetry must never kill the data path
+# ---------------------------------------------------------------------------
+
+
+class TestSinkWriteFaults:
+    def test_write_failure_counted_never_raised(self, tmp_path):
+        sink = qm.MetricsSink(str(tmp_path / "s.jsonl"))
+        qfaults.install(FaultPlan(rules={
+            "sink.write": FaultRule("error", errno_name="ENOSPC",
+                                    times=2)}))
+        try:
+            rec = sink.emit({"a": 1}, kind="bench")   # no raise
+            assert rec["a"] == 1
+            sink.emit({"a": 2}, kind="bench")
+        finally:
+            qfaults.disarm()
+        sink.emit({"a": 3}, kind="bench")
+        sink.close()
+        assert sink.write_errors == 2
+        recs = qm.read_jsonl(str(tmp_path / "s.jsonl"))
+        kept = [r for r in recs if r["kind"] == "bench"]
+        assert [r["a"] for r in kept] == [3]   # dropped ones counted
+
+
+# ---------------------------------------------------------------------------
+# serve.execute / serve.coalesce: the server's failure modes
+# ---------------------------------------------------------------------------
+
+
+class TestServeFaults:
+    def test_execute_fault_fails_batch_server_survives(self, engine):
+        srv = qv.MicroBatchServer(engine, qv.ServeConfig(max_wait_ms=1.0))
+        qfaults.install(FaultPlan(rules={
+            "serve.execute": FaultRule("error", exc="runtime",
+                                       times=1)}))
+        try:
+            fut = srv.submit(1)
+            with pytest.raises(RuntimeError, match="injected"):
+                fut.result(timeout=30)
+            # the pipeline recorded the failure and stays serviceable
+            ok = srv.submit(2)
+            assert ok.result(timeout=30).shape == (CLASSES,)
+        finally:
+            qfaults.disarm()
+            srv.close()
+
+    def test_coalescer_death_fails_queued_fast_and_rejects(self, engine):
+        srv = qv.MicroBatchServer(engine,
+                                  qv.ServeConfig(max_wait_ms=1.0),
+                                  start=False)
+        staged = [srv.submit(i) for i in range(4)]
+        qfaults.install(FaultPlan(rules={
+            "serve.coalesce": FaultRule("error", exc="runtime")}))
+        try:
+            srv.start()
+            for f in staged:
+                with pytest.raises(qv.ServerClosed):
+                    f.result(timeout=10)
+            # the watchdog marked the server broken: fail-fast, no hang
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not srv._broken:
+                time.sleep(0.01)
+            with pytest.raises(qv.ServerClosed):
+                srv.submit(99)
+            assert srv.health()["score"] == 0.0
+        finally:
+            qfaults.disarm()
+            srv.close()
+
+    def test_submit_racing_close_gets_server_closed(self, engine):
+        # the satellite fix: submit racing close() fails the future
+        # with the TYPED ServerClosed immediately (still a
+        # RuntimeError for legacy callers), never hangs
+        srv = qv.MicroBatchServer(engine, qv.ServeConfig())
+        stop = threading.Event()
+        errs = []
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                try:
+                    srv.submit(i % SN)
+                except qv.ServerClosed:
+                    errs.append("closed")
+                    return
+                except qv.OverloadError:
+                    pass
+                i += 1
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        time.sleep(0.05)
+        srv.close()
+        stop.set()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        with pytest.raises(qv.ServerClosed):
+            srv.submit(0)
+
+
+# ---------------------------------------------------------------------------
+# faults armed at rate 0: bit-identical, still sync-free
+# ---------------------------------------------------------------------------
+
+
+class TestNoFaultsArmed:
+    def test_rate_zero_plan_changes_nothing(self, artifact, engine,
+                                            serve_world):
+        _, kwargs, _, _ = artifact
+        rng = np.random.default_rng(0)
+        batches = [rng.integers(0, N, 96).astype(np.int64)
+                   for _ in range(4)]
+        seeds = np.arange(CAP, dtype=np.int32)
+
+        store = make_store(kwargs, prefetch=256, workers=2)
+        base_rows = []
+        for b in batches:
+            store.stage_frontier(b)
+            base_rows.append(np.asarray(store[jnp.asarray(b)]))
+        # rewind the key chain so both runs dispatch at the SAME key
+        # state — bit-identity is then exact, not allclose
+        engine._key = jax.random.key(123)
+        base_logits = np.asarray(engine.run(seeds))
+
+        qfaults.install(FaultPlan(seed=1, rules={
+            s: FaultRule("error", rate=0.0) for s in qfaults.SITES}))
+        try:
+            armed_store = make_store(kwargs, prefetch=256, workers=2)
+            for b, want in zip(batches, base_rows):
+                armed_store.stage_frontier(b)
+                got = np.asarray(armed_store[jnp.asarray(b)])
+                np.testing.assert_array_equal(got, want)
+            # serve logits bit-identical under the armed plan (same
+            # rewound key state)
+            engine._key = jax.random.key(123)
+            armed_logits = np.asarray(engine.run(seeds))
+            np.testing.assert_array_equal(armed_logits, base_logits)
+            # the fault layer never enters a jitted program: the serve
+            # step still traces with ZERO host syncs, plan armed
+            model, params, ij, xj, feat = serve_world
+            eng = qv.ServeEngine(model, params, (ij, xj), feat,
+                                 sizes_variants=[FULL], batch_cap=CAP)
+            args = (eng.params, jax.random.key(0), eng._feat,
+                    eng._forder, eng._indptr, eng._indices,
+                    jnp.zeros((CAP,), jnp.int32))
+            assert host_sync_eqns(eng._steps[0].raw, args) == []
+            assert qfaults.active().injected == 0
+        finally:
+            qfaults.disarm()
+        store.close()
+        armed_store.close()

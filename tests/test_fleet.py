@@ -814,3 +814,241 @@ class TestServingHealthHook:
         h = srv.health()
         assert h["score"] == pytest.approx(0.5)   # full shed, 1-step
         assert h["components"]["shed_frac"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# 10. health-weighted routing (HealthRouter)
+# ---------------------------------------------------------------------------
+
+
+class TestHealthRouter:
+    def test_drain_readmit_hysteresis(self):
+        r = qf.HealthRouter(["a", "b"], drain_below=0.25,
+                            readmit_above=0.5)
+        r.update("a", 0.1)                    # below drain_below
+        assert r.snapshot()["drained"] == ["a"]
+        r.update("a", 0.4)                    # recovered, but UNDER
+        assert r.snapshot()["drained"] == ["a"]   # readmit_above: holds
+        r.update("a", 0.6)
+        assert r.snapshot()["drained"] == []
+        assert r.snapshot()["drains"] == 1
+        assert r.snapshot()["readmits"] == 1
+
+    def test_pick_never_routes_to_drained_while_active_exists(self):
+        r = qf.HealthRouter(["a", "b", "c"], seed=2)
+        r.update("b", 0.0)                    # stale replica: drained
+        picks = {r.pick() for _ in range(64)}
+        assert "b" not in picks and picks == {"a", "c"}
+        # all drained: pick still answers (last resort beats nothing)
+        r.update("a", 0.0)
+        r.update("c", 0.0)
+        assert r.pick() in ("a", "b", "c")
+
+    def test_pick_weights_by_health(self):
+        r = qf.HealthRouter(["strong", "weak"], seed=0)
+        r.update("strong", 1.0)
+        r.update("weak", 0.3)
+        n = 600
+        weak = sum(r.pick() == "weak" for _ in range(n))
+        # expected share 0.3/1.3 ~ 0.23; seeded rng, loose band
+        assert 0.10 < weak / n < 0.40
+
+    def test_ranked_health_order_drained_last(self):
+        r = qf.HealthRouter(["a", "b", "c"], seed=0)
+        r.update("a", 0.6)
+        r.update("b", 0.9)
+        r.update("c", 0.1)                    # drained
+        assert r.ranked() == ["b", "a", "c"]
+        assert r.ranked(exclude=["b"]) == ["a", "c"]
+
+    def test_sync_folds_aggregator_snapshot(self):
+        r = qf.HealthRouter(["r0", "r1"])
+        r.sync({"replicas": {"r0": {"health": 0.0},
+                             "r1": {"health": 0.8},
+                             "r9": {"health": 1.0}}})   # auto-registers
+        snap = r.snapshot()
+        assert snap["drained"] == ["r0"]
+        assert snap["scores"]["r1"] == 0.8 and "r9" in snap["scores"]
+
+    def test_bad_thresholds_raise(self):
+        with pytest.raises(ValueError):
+            qf.HealthRouter(drain_below=0.8, readmit_above=0.5)
+
+
+# ---------------------------------------------------------------------------
+# 11. replica supervision (fake clock + fake processes: deterministic)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    _next_pid = [100]
+
+    def __init__(self):
+        self.pid = self._next_pid[0]
+        self._next_pid[0] += 1
+        self._rc = None
+
+    def poll(self):
+        return self._rc
+
+    def die(self, rc=1):
+        self._rc = rc
+
+    def terminate(self):
+        if self._rc is None:
+            self._rc = 0
+
+    def kill(self):
+        self._rc = -9
+
+    def send_signal(self, sig):
+        self._rc = -int(sig)
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+class TestReplicaSupervisor:
+    def _sup(self, **kw):
+        clk = [0.0]
+        spawned = []
+
+        def spawn(name, index, attempt):
+            p = _FakeProc()
+            spawned.append((name, attempt, p))
+            return p
+
+        kw.setdefault("backoff_s", 0.5)
+        kw.setdefault("backoff_cap_s", 4.0)
+        kw.setdefault("crash_loop_limit", 3)
+        kw.setdefault("crash_loop_window_s", 100.0)
+        kw.setdefault("healthy_uptime_s", 10.0)
+        sup = qf.ReplicaSupervisor(spawn, 2, clock=lambda: clk[0], **kw)
+        return sup, clk, spawned
+
+    def test_initial_spawn_and_restart_backoff(self):
+        sup, clk, spawned = self._sup()
+        sup.step()
+        assert [s[:2] for s in spawned] == [("r0", 0), ("r1", 0)]
+        assert all(v["alive"] for v in sup.status().values())
+        # r0 dies: restart scheduled at +0.5, not before
+        spawned[0][2].die(rc=-9)
+        clk[0] = 1.0
+        sup.step()
+        st = sup.status()
+        assert not st["r0"]["alive"] and st["r1"]["alive"]
+        assert st["r0"]["next_restart_in_s"] == 0.5
+        clk[0] = 1.4
+        sup.step()
+        assert len(spawned) == 2              # too early
+        clk[0] = 1.6
+        sup.step()
+        assert [s[:2] for s in spawned][-1] == ("r0", 1)
+        assert sup.status()["r0"]["alive"]
+        assert sup.status()["r0"]["restarts"] == 1
+        events = [e["event"] for e in sup.events]
+        assert events == ["spawn", "spawn", "exit", "restart"]
+
+    def test_backoff_doubles_then_caps_and_heals(self):
+        sup, clk, spawned = self._sup()
+        sup.step()
+        waits = []
+        for _ in range(2):                    # two quick crash cycles
+            proc = [p for n, a, p in spawned if n == "r0"][-1]
+            proc.die()
+            sup.step()
+            waits.append(sup.status()["r0"]["next_restart_in_s"])
+            clk[0] += waits[-1] + 0.01
+            sup.step()
+        assert waits == [0.5, 1.0]            # exponential
+        # healthy uptime resets the consecutive-crash count
+        clk[0] += 11.0
+        sup.step()
+        proc = [p for n, a, p in spawned if n == "r0"][-1]
+        proc.die()
+        sup.step()
+        assert sup.status()["r0"]["next_restart_in_s"] == 0.5
+
+    def test_crash_loop_opens_breaker_then_half_opens(self):
+        sup, clk, spawned = self._sup(breaker_reset_s=50.0)
+        sup.step()
+        for _ in range(3):                    # limit=3 inside window
+            proc = [p for n, a, p in spawned if n == "r0"][-1]
+            proc.die()
+            clk[0] += 0.01
+            sup.step()                        # exit (+ maybe breaker)
+            clk[0] += 5.0
+            sup.step()                        # restart (while closed)
+        st = sup.status()
+        assert st["r0"]["breaker_open"], st
+        n_spawns = len(spawned)
+        clk[0] += 10.0
+        sup.step()
+        assert len(spawned) == n_spawns       # breaker holds: no spawn
+        clk[0] += 50.0                        # cool-down elapsed
+        sup.step()
+        assert len(spawned) == n_spawns + 1   # half-open: one retry
+        st = sup.status()
+        assert not st["r0"]["breaker_open"]
+        assert st["r0"]["consecutive_crashes"] == 0
+        assert "breaker_open" in [e["event"] for e in sup.events]
+        assert "breaker_reset" in [e["event"] for e in sup.events]
+
+    def test_spawn_failure_backs_off_and_spares_siblings(self):
+        clk = [0.0]
+        calls = []
+
+        def spawn(name, index, attempt):
+            calls.append(name)
+            if name == "r0":
+                raise OSError("no such binary")
+            return _FakeProc()
+
+        sup = qf.ReplicaSupervisor(spawn, 2, backoff_s=0.5,
+                                   backoff_cap_s=4.0,
+                                   crash_loop_limit=3,
+                                   crash_loop_window_s=100.0,
+                                   clock=lambda: clk[0])
+        sup.step()
+        # the failing spawn neither aborted the pass (r1 is up) nor
+        # hot-loops (r0 waits out a backoff before the next attempt)
+        st = sup.status()
+        assert st["r1"]["alive"] and not st["r0"]["alive"]
+        assert st["r0"]["next_restart_in_s"] == 0.5
+        sup.step()
+        assert calls.count("r0") == 1         # backoff holds
+        clk[0] = 0.6
+        sup.step()
+        assert calls.count("r0") == 2
+        assert sup.status()["r0"]["next_restart_in_s"] == 1.0
+        # persistent spawn failure trips the breaker like a crash loop
+        clk[0] = 2.0
+        sup.step()
+        assert sup.status()["r0"]["breaker_open"]
+        events = [e["event"] for e in sup.events]
+        assert "spawn_error" in events and "breaker_open" in events
+        sup.close()
+
+    def test_kill_and_close(self):
+        sup, clk, spawned = self._sup()
+        sup.step()
+        pid = sup.kill("r1")
+        assert pid == spawned[1][2].pid
+        assert spawned[1][2].poll() is not None
+        sup.close()
+        # close terminates the survivor
+        assert spawned[0][2].poll() is not None
+
+    def test_events_reach_the_sink_as_chaos_records(self, tmp_path):
+        path = str(tmp_path / "chaos.jsonl")
+        sink = qm.MetricsSink(path)
+        clk = [0.0]
+        sup = qf.ReplicaSupervisor(
+            lambda n, i, a: _FakeProc(), 1, backoff_s=0.1,
+            sink=sink, clock=lambda: clk[0])
+        sup.step()
+        sup.close()
+        sink.close()
+        recs = [r for r in qm.read_jsonl(path) if r["kind"] == "chaos"]
+        assert [r["event"] for r in recs] == ["spawn"]
+        assert recs[0]["replica"] == "r0" and "pid" in recs[0]

@@ -259,7 +259,14 @@ class TestInjectedSlowdown:
         prof.add_group(_group(scale_a=2, scale_b=2))
         for _ in range(8):                 # the healthy baseline
             prof.run()
-        base_share = hub.series["stage_share:prof_test/B"].last()
+        # judge the MEDIAN of the baseline window, not the last point:
+        # on this 2-vCPU box a single scheduler stall can skew one
+        # pass's share of two equal microsecond stages past any sane
+        # tolerance (observed under full-suite load), and the stall is
+        # box noise, not attribution
+        import numpy as np
+        series = hub.series["stage_share:prof_test/B"]
+        base_share = float(np.median(series.values()[-8:]))
         assert base_share == pytest.approx(0.5, abs=0.25)
 
         # deploy the de-optimized variant of stage B (50x the work)
@@ -267,7 +274,7 @@ class TestInjectedSlowdown:
         slow.add_group(_group(scale_a=2, scale_b=100))
         for _ in range(8):
             slow.run()
-        slow_share = hub.series["stage_share:prof_test/B"].last()
+        slow_share = float(np.median(series.values()[-8:]))
         assert slow_share > 0.8, \
             "attribution did not shift to the de-optimized stage"
         anomalies = [a for a in hub.anomalies

@@ -1,0 +1,580 @@
+"""RPC plane + the chaos kill scenario (ROADMAP frontier 4's gate).
+
+Covers, tier-1:
+
+- the length-prefixed wire protocol (frames, typed errors, ping);
+- deadline budgets: spent-before-arrival shed at the RPC front end,
+  spent-while-queued shed at the coalescer (``submit(deadline=)``) —
+  both BEFORE the request costs a batch slot;
+- client discipline: timeout -> jittered-backoff retry to the
+  next-healthiest replica, hedged requests (first answer wins),
+  typed ``AllAttemptsFailed`` with causes — zero silent losses;
+- the RPC front end over a REAL jitted serve engine (rows match the
+  direct ``ServeEngine.run`` reference);
+- THE chaos kill test: 3 replica processes under a
+  ``ReplicaSupervisor``, a seeded ``FaultPlan`` SIGKILLs one at
+  sustained load — every request resolves (result or typed error,
+  zero lost), the aggregator detects within one aggregation interval
+  past the staleness horizon, the router drains and re-admits, the
+  supervisor restarts the replica within its backoff window, accepted
+  p99 stays bounded. The replicas are jax-free stdlib processes
+  (loading ``quiver_tpu/rpc.py`` through a synthetic package), so the
+  whole fleet boots in ~a second on the tier-1 box; the
+  real-engine path is pinned separately above.
+"""
+
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import concurrent.futures as cf
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu import fleet as qf
+from quiver_tpu import metrics as qm
+from quiver_tpu import rpc as qrpc
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops import sample_multihop
+from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                       masked_feature_gather)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, DIM, CLASSES, CAP = 300, 8, 3, 8
+FULL = [4, 4]
+
+
+# ---------------------------------------------------------------------------
+# helpers: fake backends + a raw synchronous wire caller
+# ---------------------------------------------------------------------------
+
+
+def fake_row(node: int) -> np.ndarray:
+    """The deterministic row every fake backend serves — what the
+    chaos harness verifies end-to-end."""
+    return np.array([node, node * 0.5, node % 7], np.float32)
+
+
+class FakeBackend:
+    def __init__(self, delay_s: float = 0.0, fail=None):
+        self.delay_s = delay_s
+        self.fail = fail
+        self.calls = 0
+
+    def submit(self, node, context=None, deadline=None):
+        self.calls += 1
+        fut: cf.Future = cf.Future()
+        if self.fail is not None:
+            fut.set_exception(self.fail())
+            return fut
+        if self.delay_s:
+            def resolve():
+                if fut.set_running_or_notify_cancel():
+                    fut.set_result(fake_row(node))
+            t = threading.Timer(self.delay_s, resolve)
+            t.daemon = True
+            t.start()
+        else:
+            fut.set_result(fake_row(node))
+        return fut
+
+    def health(self):
+        return {"score": 1.0}
+
+
+def sync_call(port, msg, timeout=10.0):
+    """One raw length-prefixed round trip (no client machinery)."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        body = json.dumps(msg).encode()
+        s.sendall(struct.pack(">I", len(body)) + body)
+
+        def recvn(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = s.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError("peer closed")
+                buf += chunk
+            return buf
+
+        (n,) = struct.unpack(">I", recvn(4))
+        return json.loads(recvn(n))
+
+
+def free_ports(k):
+    socks = [socket.socket() for _ in range(k)]
+    try:
+        for s in socks:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+
+class TestWireProtocol:
+    def test_lookup_ping_and_bad_op(self):
+        srv = qrpc.RpcServer(FakeBackend())
+        try:
+            r = sync_call(srv.port, {"op": "lookup", "id": 1, "node": 5})
+            assert r["ok"] and r["id"] == 1
+            np.testing.assert_array_equal(
+                np.asarray(r["row"], np.float32), fake_row(5))
+            p = sync_call(srv.port, {"op": "ping", "id": 2})
+            assert p["ok"] and p["pong"] and p["health"] == 1.0
+            bad = sync_call(srv.port, {"op": "frobnicate", "id": 3})
+            assert not bad["ok"] and bad["error"] == "ServerError"
+        finally:
+            srv.close()
+
+    def test_oversized_frame_hangs_up(self):
+        srv = qrpc.RpcServer(FakeBackend())
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port),
+                                          timeout=5) as s:
+                s.settimeout(5)
+                s.sendall(struct.pack(">I", qrpc.MAX_FRAME + 1))
+                assert s.recv(4) == b""        # server hung up
+            # and the server still serves the next connection
+            r = sync_call(srv.port, {"op": "ping", "id": 1})
+            assert r["ok"]
+        finally:
+            srv.close()
+
+    def test_backend_exception_maps_to_typed_error(self):
+        srv = qrpc.RpcServer(FakeBackend(fail=lambda: qv.OverloadError(
+            "queue full")))
+        try:
+            r = sync_call(srv.port, {"op": "lookup", "id": 1, "node": 0})
+            assert not r["ok"] and r["error"] == "Overloaded"
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline budgets
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_budget_spent_before_arrival_sheds_at_front_end(self):
+        backend = FakeBackend()
+        srv = qrpc.RpcServer(backend)
+        try:
+            r = sync_call(srv.port, {"op": "lookup", "id": 1,
+                                     "node": 3, "budget_ms": -5.0})
+            assert not r["ok"] and r["error"] == "DeadlineExceeded"
+            assert backend.calls == 0          # never cost a batch slot
+            assert srv.shed_deadline == 1
+        finally:
+            srv.close()
+
+    def test_deadline_passes_while_waiting_for_answer(self):
+        srv = qrpc.RpcServer(FakeBackend(delay_s=1.0))
+        try:
+            t0 = time.perf_counter()
+            r = sync_call(srv.port, {"op": "lookup", "id": 1,
+                                     "node": 3, "budget_ms": 60.0})
+            took = time.perf_counter() - t0
+            assert not r["ok"] and r["error"] == "DeadlineExceeded"
+            assert took < 0.9                  # answered AT the budget,
+        finally:                               # not the backend's pace
+            srv.close()
+
+    def test_coalescer_sheds_expired_before_batching(self, engine):
+        srv = qv.MicroBatchServer(engine,
+                                  qv.ServeConfig(max_wait_ms=1.0),
+                                  start=False)
+        dead = srv.submit(1, deadline=time.perf_counter() - 0.01)
+        live = srv.submit(2)
+        srv.start()
+        with pytest.raises(qv.DeadlineExceeded):
+            dead.result(timeout=10)
+        assert live.result(timeout=30).shape == (CLASSES,)
+        snap = srv.snapshot()
+        assert snap["serving"]["deadline_expired"] == 1
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# client: retries, hedging, typed failure
+# ---------------------------------------------------------------------------
+
+
+class TestClientDiscipline:
+    def test_retry_routes_to_next_healthiest(self):
+        sick = qrpc.RpcServer(FakeBackend(
+            fail=lambda: RuntimeError("boom")))
+        well = qrpc.RpcServer(FakeBackend())
+        router = qf.HealthRouter(["sick", "well"], seed=0)
+        router.update("sick", 1.0)
+        router.update("well", 0.6)   # the sick one ranks FIRST
+        cli = qrpc.RpcClient(
+            {"sick": ("127.0.0.1", sick.port),
+             "well": ("127.0.0.1", well.port)},
+            router=router, retries=3, hedge=False, backoff_ms=5.0,
+            seed=1)
+        try:
+            rows = [cli.lookup(n, budget_ms=5000) for n in range(6)]
+            for n, row in enumerate(rows):
+                np.testing.assert_array_equal(row, fake_row(n))
+            s = cli.stats()
+            assert s["retries"] >= 1           # at least one re-route
+        finally:
+            cli.close()
+            sick.close()
+            well.close()
+
+    def test_hedge_first_answer_wins(self):
+        slow = qrpc.RpcServer(FakeBackend(delay_s=0.8))
+        fast = qrpc.RpcServer(FakeBackend())
+        router = qf.HealthRouter(["slow", "fast"], seed=0)
+        router.update("slow", 1.0)
+        router.drain("fast")         # primary is ALWAYS the slow one;
+        # the drained-but-listed fast replica is exactly what the
+        # hedge reaches for when the primary goes quiet
+        cli = qrpc.RpcClient(
+            {"slow": ("127.0.0.1", slow.port),
+             "fast": ("127.0.0.1", fast.port)},
+            router=router, retries=0, timeout_ms=5000,
+            hedge=True, hedge_delay_ms=40.0, seed=1)
+        try:
+            t0 = time.perf_counter()
+            row = cli.lookup(9, budget_ms=5000)
+            took = time.perf_counter() - t0
+            np.testing.assert_array_equal(row, fake_row(9))
+            assert took < 0.7                  # the hedge answered
+            s = cli.stats()
+            assert s["hedges"] >= 1 and s["hedge_wins"] >= 1
+        finally:
+            cli.close()
+            slow.close()
+            fast.close()
+
+    def test_all_attempts_failed_carries_causes(self):
+        sick = qrpc.RpcServer(FakeBackend(
+            fail=lambda: RuntimeError("boom")))
+        cli = qrpc.RpcClient({"sick": ("127.0.0.1", sick.port)},
+                             retries=1, hedge=False, backoff_ms=1.0)
+        try:
+            with pytest.raises(qrpc.AllAttemptsFailed) as ei:
+                cli.lookup(1, budget_ms=5000)
+            assert len(ei.value.causes) >= 2   # every attempt recorded
+            assert cli.stats()["errors"]["AllAttemptsFailed"] == 1
+        finally:
+            cli.close()
+            sick.close()
+
+    def test_dead_replica_is_replica_unavailable_then_rerouted(self):
+        dead_port, = free_ports(1)             # nothing listens here
+        well = qrpc.RpcServer(FakeBackend())
+        router = qf.HealthRouter(["dead", "well"], seed=0)
+        router.update("dead", 1.0)
+        router.update("well", 0.5)
+        cli = qrpc.RpcClient(
+            {"dead": ("127.0.0.1", dead_port),
+             "well": ("127.0.0.1", well.port)},
+            router=router, retries=2, hedge=False, backoff_ms=2.0)
+        try:
+            row = cli.lookup(4, budget_ms=5000)
+            np.testing.assert_array_equal(row, fake_row(4))
+        finally:
+            cli.close()
+            well.close()
+
+
+# ---------------------------------------------------------------------------
+# the RPC front end over a REAL jitted serve engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_world():
+    rng = np.random.default_rng(7)
+    deg = rng.integers(1, 4, N)
+    indptr = np.zeros(N + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, N, int(indptr[-1]), dtype=np.int32)
+    feat = rng.standard_normal((N, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=8, out_dim=CLASSES, num_layers=2,
+                      dropout=0.0)
+    ij = jnp.asarray(indptr.astype(np.int32))
+    xj = jnp.asarray(indices)
+    n_id, layers = sample_multihop(ij, xj,
+                                   jnp.arange(4, dtype=jnp.int32),
+                                   FULL, jax.random.key(0))
+    state = init_state(model, optax.adam(1e-3),
+                       masked_feature_gather(jnp.asarray(feat), n_id),
+                       layers_to_adjs(layers, 4, FULL),
+                       jax.random.key(1))
+    return model, state.params, ij, xj, feat
+
+
+@pytest.fixture(scope="module")
+def engine(serve_world):
+    model, params, ij, xj, feat = serve_world
+    return qv.ServeEngine(model, params, (ij, xj), feat,
+                          sizes_variants=[FULL],
+                          batch_cap=CAP).warmup()
+
+
+class TestRpcOverRealEngine:
+    def test_rows_match_direct_engine_reference(self, engine):
+        # max degree < fanout: per-node logits are key-independent up
+        # to float noise — compare allclose like test_serving does
+        reference = {v: np.asarray(engine.run(
+            np.array([v], np.int32)))[0] for v in range(16)}
+        srv = qv.MicroBatchServer(engine,
+                                  qv.ServeConfig(max_wait_ms=1.0))
+        front = qrpc.RpcServer(srv)
+        cli = qrpc.RpcClient({"r0": ("127.0.0.1", front.port)},
+                             retries=1, hedge=False)
+        try:
+            for v in range(16):
+                row = cli.lookup(v, budget_ms=30_000)
+                np.testing.assert_allclose(row, reference[v],
+                                           rtol=1e-5, atol=1e-6)
+        finally:
+            cli.close()
+            front.close()
+            srv.close()
+
+    def test_trace_context_continues_into_replica_spans(self, engine):
+        from quiver_tpu import tracing
+        srv = qv.MicroBatchServer(engine,
+                                  qv.ServeConfig(max_wait_ms=1.0))
+        front = qrpc.RpcServer(srv)
+        cli = qrpc.RpcClient({"r0": ("127.0.0.1", front.port)},
+                             retries=1, hedge=False)
+        tracing.clear()
+        tracing.enable()
+        try:
+            ctx = tracing.inject({})
+            cli.lookup(3, budget_ms=30_000, context=ctx)
+            tids = {r[4] for r in tracing.get_tracer().records()}
+            assert ctx[tracing.CTX_TRACE_ID] in tids
+        finally:
+            tracing.disable()
+            tracing.clear()
+            cli.close()
+            front.close()
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# THE chaos kill test — fleet of 3, one SIGKILLed at sustained load
+# ---------------------------------------------------------------------------
+
+# jax-free replica process: loads quiver_tpu/rpc.py through a synthetic
+# package (no package __init__, no jax — boots in ~300 ms), serves the
+# deterministic fake_row backend on a FIXED port, and heartbeats a
+# sink file every 50 ms (what the FleetAggregator judges staleness
+# by). A FaultPlan arrives via QT_FAULTS in the environment — the
+# seeded `rpc.request:kill,after=K` rule IS the chaos trigger.
+_REPLICA = r"""
+import importlib, json, os, sys, time, types
+import concurrent.futures as cf
+import numpy as np
+
+root, name, port_s, sink_path = sys.argv[1:5]
+pkg = types.ModuleType("_qt_sr")
+pkg.__path__ = [os.path.join(root, "quiver_tpu")]
+sys.modules["_qt_sr"] = pkg
+rpc = importlib.import_module("_qt_sr.rpc")
+
+
+class Backend:
+    def submit(self, node, context=None, deadline=None):
+        fut = cf.Future()
+        fut.set_result(np.array([node, node * 0.5, node % 7],
+                                np.float32))
+        return fut
+
+    def health(self):
+        return {"score": 1.0}
+
+
+srv = rpc.RpcServer(Backend(), port=int(port_s))
+with open(sink_path, "a", buffering=1) as f:
+    f.write(json.dumps({"ts": time.time(), "kind": "meta",
+                        "host": "fake", "pid": os.getpid(),
+                        "start_ts": time.time(),
+                        "replica": name}) + "\n")
+    beats = 0
+    while True:
+        beats += 1
+        f.write(json.dumps({"ts": time.time(), "kind": "step_stats",
+                            "counters": {"hot_rows": beats}}) + "\n")
+        time.sleep(0.05)
+"""
+
+KILL_AFTER = 35
+
+
+class TestChaosKillFleet:
+    def test_seeded_kill_detect_reroute_restart(self, tmp_path):
+        names = ["r0", "r1", "r2"]
+        ports = dict(zip(names, free_ports(3)))
+        sinks = {n: str(tmp_path / f"{n}.jsonl") for n in names}
+        ev_path = str(tmp_path / "events.jsonl")
+        ev_sink = qm.MetricsSink(ev_path)
+        plan = qv.FaultPlan(seed=7, rules={
+            "rpc.request": qv.FaultRule("kill", after=KILL_AFTER)})
+
+        def spawn(name, index, attempt):
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("QT_FAULTS", "QT_FAULTS_SEED")}
+            if name == "r0" and attempt == 0:
+                # the seeded kill arms ONLY the victim's first life:
+                # the restarted replica serves unarmed (determinism
+                # from the plan's request count, not wall clock)
+                env.update(plan.env())
+            return subprocess.Popen(
+                [sys.executable, "-c", _REPLICA, REPO, name,
+                 str(ports[name]), sinks[name]],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+
+        # staleness horizon BELOW the restart backoff: the aggregator
+        # must detect and the router must drain BEFORE the supervisor
+        # heals — every stage of detect -> drain -> restart ->
+        # re-admit observable in one run
+        sup = qf.ReplicaSupervisor(
+            spawn, 3, names=names, backoff_s=1.2, backoff_cap_s=2.4,
+            monitor_interval_s=0.05, healthy_uptime_s=5.0,
+            sink=ev_sink).start()
+        agg = qf.FleetAggregator(sinks, interval_s=0.2,
+                                 stale_after_s=0.4,
+                                 sink=ev_sink)
+        router = qf.HealthRouter(names, seed=3)
+        agg.on_poll.append(router.sync)
+        cli = qrpc.RpcClient(
+            {n: ("127.0.0.1", p) for n, p in ports.items()},
+            router=router, timeout_ms=400.0, retries=3,
+            backoff_ms=20.0, backoff_cap_ms=150.0,
+            hedge=True, hedge_delay_ms=60.0, seed=5)
+        lat_done: dict = {}
+        try:
+            # wait for all three replicas to answer
+            deadline = time.monotonic() + 20.0
+            up = set()
+            while time.monotonic() < deadline and len(up) < 3:
+                for n in names:
+                    if n not in up:
+                        try:
+                            if cli.ping(n, timeout_ms=300)["ok"]:
+                                up.add(n)
+                        except Exception:
+                            pass
+                time.sleep(0.05)
+            assert up == set(names), f"fleet never came up: {up}"
+            # staleness clock starts only once the fleet is up — a
+            # replica still booting must not read as a detection
+            agg.start()
+
+            # sustained open-loop load; the seeded plan kills r0 after
+            # its 35th request, mid-load
+            futs = []
+            t0 = time.perf_counter()
+            for k in range(240):
+                target = t0 + k * 0.018
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                fut = cli.lookup_future(k % 50, budget_ms=8000.0)
+                t_sub = time.perf_counter()
+                fut.add_done_callback(
+                    lambda f, i=k, t=t_sub:
+                    lat_done.setdefault(i, time.perf_counter() - t))
+                futs.append((k, fut))
+
+            # ZERO silently lost: every future resolves, and with 3
+            # retries across a 3-replica fleet every one SUCCEEDS
+            failed = []
+            for k, fut in futs:
+                try:
+                    row = fut.result(timeout=60)
+                    np.testing.assert_array_equal(row, fake_row(k % 50))
+                except qrpc.RpcError as e:
+                    failed.append((k, type(e).__name__))
+            assert not failed, f"requests lost to the kill: {failed}"
+
+            # the victim died and was restarted by the supervisor
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                st = sup.status()
+                if st["r0"]["alive"] and st["r0"]["restarts"] >= 1:
+                    break
+                time.sleep(0.1)
+            st = sup.status()
+            assert st["r0"]["restarts"] >= 1, st
+            assert st["r0"]["alive"] and not st["r0"]["breaker_open"]
+            assert st["r1"]["restarts"] == 0 and st["r2"]["restarts"] == 0
+
+            # the restarted replica re-admits and serves again
+            deadline = time.monotonic() + 15.0
+            served = False
+            while time.monotonic() < deadline and not served:
+                try:
+                    served = cli.ping("r0", timeout_ms=300)["ok"]
+                except Exception:
+                    time.sleep(0.1)
+            assert served, "restarted replica never served"
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and \
+                    "r0" in router.snapshot()["drained"]:
+                time.sleep(0.1)
+            rsnap = router.snapshot()
+            assert rsnap["drains"] >= 1, rsnap      # it WAS drained
+            assert "r0" not in rsnap["drained"], rsnap   # and re-admitted
+        finally:
+            cli.close()
+            agg.close()
+            sup.close()
+            ev_sink.close()
+
+        # -- detection latency: staleness flagged within one
+        # aggregation interval past the staleness horizon (generous
+        # slack for this box's scheduler)
+        events = qm.read_jsonl(ev_path)
+        exits = [r for r in events if r.get("kind") == "chaos"
+                 and r.get("event") == "exit" and r.get("replica") == "r0"]
+        assert exits, f"supervisor never logged the exit: {events[:5]}"
+        # only staleness AT/AFTER the exit counts as detecting THIS
+        # failure (a startup blip would fake a negative latency)
+        stales = [r for r in events if r.get("kind") == "anomaly"
+                  and r.get("detector") == "staleness"
+                  and r.get("replica") == "r0"
+                  and r["ts"] >= exits[0]["ts"]]
+        assert stales, "aggregator never flagged the dead replica"
+        detect_s = stales[0]["ts"] - exits[0]["ts"]
+        assert 0.0 <= detect_s <= 0.4 + 0.2 + 2.0, \
+            f"detection took {detect_s:.2f}s"
+        restarts = [r for r in events if r.get("kind") == "chaos"
+                    and r.get("event") == "restart"
+                    and r.get("replica") == "r0"]
+        assert restarts, "supervisor never logged the restart"
+
+        # -- accepted p99 bounded: < 2x the 1 s steady-state budget
+        lats = sorted(lat_done.values())
+        assert lats, "no latencies recorded"
+        p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)]
+        assert p99 < 2.0, f"accepted p99 {p99:.3f}s unbounded"

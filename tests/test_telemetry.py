@@ -527,14 +527,16 @@ class TestPrefetchObserveInto:
         pf._counters = np.array([30, 10, 100], np.int64)
         pf._published, pf._dropped = 4, 1
         pf._truncated = 0
-        pf._hub_last = np.zeros(6, np.int64)
+        pf._io_total = np.zeros(6, np.int64)
+        pf._hub_last = np.zeros(7, np.int64)
         pf._hub_t = None
         pf._lock = threading.Lock()
         hub = qt.TelemetryHub(watches=())
         d = pf.observe_into(hub)
         assert d == {"hit_rows": 30, "sync_rows": 10,
                      "staged_rows": 100, "published": 4, "dropped": 1,
-                     "truncated_rows": 0}
+                     "truncated_rows": 0,
+                     "staging_worker_restarts": 0}
         assert hub.series["prefetch_hit_rate"].last() == \
             pytest.approx(0.75)
         assert hub.series["prefetch_drop_rate"].last() == \
@@ -543,15 +545,18 @@ class TestPrefetchObserveInto:
         assert "cold_staged_rows_per_s" not in hub.series
         pf._counters = np.array([40, 40, 150], np.int64)
         pf._truncated = 7
+        pf._io_total[5] = 2        # two staging-worker restarts since
         d = pf.observe_into(hub)                   # the DELTA, not the
         assert d["hit_rows"] == 10                 # lifetime total
         assert d["truncated_rows"] == 7
+        assert d["staging_worker_restarts"] == 2
         assert d["staged_rows_per_s"] > 0          # 50 rows / interval
         assert hub.series["prefetch_hit_rate"].last() == \
             pytest.approx(10 / 40)
         assert hub.series["cold_staged_rows_per_s"].last() == \
             pytest.approx(d["staged_rows_per_s"])
         assert hub.series["prefetch_truncated_rows"].last() == 7
+        assert hub.series["staging_worker_restarts"].last() == 2
 
 
 class TestFlightRecorder:
