@@ -88,6 +88,14 @@ SHED_LADDER = [[10, 5], [4, 2], [2, 1]]
 #: after serving this many RPC requests (deterministic by count)
 CHAOS_KILL_AFTER = 40
 
+TAIL_METRIC = ("assembled tail-sampled traces under seeded slow+error "
+               "requests (3-replica fleet, client + replica samplers)")
+#: the tail fleet's seeded triggers: one replica delays a batch (the
+#: slow request), another errors one (the failed request) — both by
+#: deterministic batch count, both mid-load
+TAIL_SLOW_AFTER = 15
+TAIL_ERROR_AFTER = 15
+
 
 def _record(value=None, err=None, skipped=False, **extra):
     rec = {"metric": METRIC, "value": value, "unit": "requests/s"}
@@ -377,6 +385,79 @@ def fleet_plane_ab(qv, engine, cfg, rate, trial_s, n_nodes, best_of,
     }
 
 
+def tail_ab(qv, engine, cfg, rate, trial_s, n_nodes, best_of,
+            budget_ms):
+    """A/B the ALWAYS-ON tail sampler (tracing enabled + sampler
+    attached + kept traces emitted to a real sink) against the
+    detached production default, arms interleaved per rep (the
+    bench-box protocol — this box's scheduler drifts minute-to-minute)
+    at the same stable half-sustained operating point as the tracing
+    and fleet A/Bs. The claim under test: always-on tail sampling
+    costs throughput within noise, and keeps only the outcome-worthy
+    sliver — the completed-rps ratio and the kept-trace fraction both
+    land in the JSON as bench_regress trajectory keys."""
+    import tempfile
+
+    from quiver_tpu import tracing
+    from quiver_tpu.metrics import MetricsSink
+    from quiver_tpu.tailsampling import TailSampler
+
+    off_reps, on_reps = [], []
+    kept = completed = evicted = 0
+    high_water = cap = 0
+    policy_counts = {}
+    d = tempfile.mkdtemp(prefix="qt_tail_ab_")
+    for r in range(best_of):
+        off_reps.append(open_loop_trial(
+            qv, engine, rate, trial_s, n_nodes, cfg, seed=900 + r))
+        sink = MetricsSink(os.path.join(d, f"tail{r}.jsonl"))
+        sampler = TailSampler(sink=sink, max_pending=1024,
+                              latency_source=lambda: float(budget_ms),
+                              head_rate=0.01, seed=r)
+        tracing.clear()
+        sampler.attach()
+        try:
+            on_reps.append(open_loop_trial(
+                qv, engine, rate, trial_s, n_nodes, cfg,
+                seed=1000 + r, inject_context=True))
+        finally:
+            sampler.detach()
+            tracing.disable()
+            tracing.clear()
+        st = sampler.stats()
+        kept += st["kept"]
+        completed += st["completed"]
+        evicted += st["evicted"]
+        high_water = max(high_water, st["pending_high_water"])
+        cap = st["pending_capacity"]
+        for k, v in st["kept_by_policy"].items():
+            policy_counts[k] = policy_counts.get(k, 0) + v
+        sink.close()
+
+    def arm(reps):
+        t = best_trial(reps)
+        t["sustained"] = is_sustained(t, budget_ms, trial_s)
+        return {k: t[k] for k in ("completed_rps", "p50_ms", "p99_ms",
+                                  "rejected", "sustained")}
+
+    off, on = arm(off_reps), arm(on_reps)
+    return {
+        "rate_rps": round(rate, 1),
+        "detached": off,
+        "attached": on,
+        "rps_ratio": (round(on["completed_rps"]
+                            / off["completed_rps"], 4)
+                      if off["completed_rps"] else None),
+        "traces_completed": completed,
+        "traces_kept": kept,
+        "kept_frac": round(kept / completed, 4) if completed else None,
+        "kept_by_policy": policy_counts,
+        "pending_high_water": high_water,
+        "pending_capacity": cap,
+        "evicted": evicted,
+    }
+
+
 # -- chaos: replica entry point + the kill A/B -------------------------------
 
 
@@ -447,6 +528,17 @@ def run_replica(a) -> int:
         max_wait_ms=2.0, slo_p99_ms=a.budget_ms))
     qrpc.RpcServer(srv, port=a.port)
     sink = MetricsSink(a.replica_sink, replica=a.replica_name)
+    if os.environ.get("QT_TAIL"):
+        # always-on tail sampling: kept traces ride the SAME heartbeat
+        # sink as kind `trace`, so the fleet aggregator (and the
+        # --tail-only validation) assemble them without a new channel
+        from quiver_tpu import tracing as qtracing
+        from quiver_tpu.tailsampling import (TailSampler,
+                                             latency_source_from)
+        qtracing.set_replica(a.replica_name)
+        TailSampler(sink=sink,
+                    latency_source=latency_source_from(slo=srv.slo),
+                    head_rate=0.0).attach()
     while True:
         srv.emit(sink)                  # the heartbeat the fleet
         time.sleep(0.1)                 # aggregator judges staleness by
@@ -465,6 +557,48 @@ def _free_ports(k):
             s.close()
 
 
+def _spawn_replica(name, port, sink_path, budget_ms, env_extra=None,
+                   fake=False):
+    """One serve-replica child (the ``--replica`` entry of this
+    file): the parent's QT_FAULTS* scrubbed — each child's fault plan
+    (and QT_TAIL) arrives via ``env_extra`` only — stdout/stderr
+    silenced."""
+    import subprocess
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("QT_FAULTS", "QT_FAULTS_SEED", "QT_TAIL")}
+    if env_extra:
+        env.update(env_extra)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--replica", "--replica-name", name,
+           "--port", str(port),
+           "--replica-sink", sink_path,
+           "--budget-ms", str(budget_ms)]
+    if fake:
+        cmd.append("--replica-fake")
+    return subprocess.Popen(cmd, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_fleet_up(cli, names, timeout_s=300.0):
+    """Ping every replica until the whole fleet answers (jax import +
+    world build dominate the children's boot); raises naming the
+    stragglers on timeout."""
+    deadline = time.monotonic() + timeout_s
+    up = set()
+    while time.monotonic() < deadline and len(up) < len(names):
+        for n in names:
+            if n not in up:
+                try:
+                    if cli.ping(n, timeout_ms=400)["ok"]:
+                        up.add(n)
+                except Exception:
+                    pass
+        time.sleep(0.1)
+    if up != set(names):
+        raise RuntimeError(f"fleet never came up: {sorted(up)}")
+
+
 def chaos_ab(smoke: bool, budget_ms: float, rate_rps: float = None,
              trial_s: float = None):
     """Sustained-rate load vs the same fleet shape with a seeded
@@ -478,7 +612,6 @@ def chaos_ab(smoke: bool, budget_ms: float, rate_rps: float = None,
     from quiver_tpu import rpc as qrpc
     from quiver_tpu.metrics import MetricsSink, read_jsonl
 
-    import subprocess
     import tempfile
 
     names = ["r0", "r1", "r2"]
@@ -499,22 +632,14 @@ def chaos_ab(smoke: bool, budget_ms: float, rate_rps: float = None,
         ev_sink = MetricsSink(ev_path)
 
         def spawn(name, index, attempt):
-            env = {k: v for k, v in os.environ.items()
-                   if k not in ("QT_FAULTS", "QT_FAULTS_SEED")}
+            extra = {}
             if armed and name == "r0" and attempt == 0:
-                env.update(kill_plan.env())
+                extra = kill_plan.env()
             elif armed and not smoke:
-                env.update(bg_plan.env())
-            cmd = [sys.executable, os.path.abspath(__file__),
-                   "--replica", "--replica-name", name,
-                   "--port", str(ports[name]),
-                   "--replica-sink", sinks[name],
-                   "--budget-ms", str(budget_ms)]
-            if smoke:
-                cmd.append("--replica-fake")
-            return subprocess.Popen(cmd, env=env,
-                                    stdout=subprocess.DEVNULL,
-                                    stderr=subprocess.DEVNULL)
+                extra = bg_plan.env()
+            return _spawn_replica(name, ports[name], sinks[name],
+                                  budget_ms, env_extra=extra,
+                                  fake=smoke)
 
         # the staleness horizon sits BELOW the restart backoff on
         # purpose: the aggregator must detect + the router must drain
@@ -536,19 +661,7 @@ def chaos_ab(smoke: bool, budget_ms: float, rate_rps: float = None,
         lat = {}
         errors = {}
         try:
-            deadline = time.monotonic() + (30.0 if smoke else 300.0)
-            up = set()
-            while time.monotonic() < deadline and len(up) < 3:
-                for n in names:
-                    if n not in up:
-                        try:
-                            if cli.ping(n, timeout_ms=400)["ok"]:
-                                up.add(n)
-                        except Exception:
-                            pass
-                time.sleep(0.1)
-            if up != set(names):
-                raise RuntimeError(f"fleet never came up: {sorted(up)}")
+            _wait_fleet_up(cli, names, 30.0 if smoke else 300.0)
             # the aggregator's staleness clock starts only once the
             # fleet is actually up — a replica still booting must not
             # read as a detected failure
@@ -660,6 +773,134 @@ def chaos_ab(smoke: bool, budget_ms: float, rate_rps: float = None,
     return out
 
 
+def tail_fleet(budget_ms: float, rate_rps: float = 80.0,
+               n_req: int = 240):
+    """The ``--tail-only`` validation (chip_suite's ``trace``
+    section): 3 REAL serve replicas, each running an always-on
+    ``TailSampler`` into its heartbeat sink (``QT_TAIL=1`` in
+    ``run_replica``), a tracing client whose ``RpcClient`` injects a
+    global trace context per request — and two seeded mid-load
+    faults: one replica DELAYS a batch (the slow request the
+    ``latency_over_p99`` policy must keep) and another ERRORS one
+    (the ``error`` policy's request). The verdict: both traces kept
+    AND assembled across client + replica segments with a dominant
+    span identified, healthy traces ~all dropped, the pending table
+    bounded. Returns ``(record, failures)``."""
+    import tempfile
+
+    import quiver_tpu as qv
+    from quiver_tpu import rpc as qrpc
+    from quiver_tpu import tracing
+    from quiver_tpu.metrics import MetricsSink, read_jsonl
+    from quiver_tpu.tailsampling import TailSampler, TraceStore
+
+    names = ["r0", "r1", "r2"]
+    d = tempfile.mkdtemp(prefix="qt_tail_fleet_")
+    ports = dict(zip(names, _free_ports(3)))
+    sinks = {n: os.path.join(d, f"{n}.jsonl") for n in names}
+    slow_plan = qv.FaultPlan(seed=5, rules={
+        "serve.execute": qv.FaultRule("delay", after=TAIL_SLOW_AFTER,
+                                      times=1, delay_ms=600.0)})
+    err_plan = qv.FaultPlan(seed=6, rules={
+        "serve.execute": qv.FaultRule("error", exc="runtime",
+                                      after=TAIL_ERROR_AFTER, times=1)})
+    procs = []
+    for name in names:
+        extra = {"QT_TAIL": "1"}
+        if name == "r1":
+            extra.update(slow_plan.env())
+        elif name == "r2":
+            extra.update(err_plan.env())
+        procs.append(_spawn_replica(name, ports[name], sinks[name],
+                                    budget_ms, env_extra=extra))
+    client_path = os.path.join(d, "client.jsonl")
+    client_sink = MetricsSink(client_path, replica="client")
+    tracing.set_replica("client")
+    tracing.clear()
+    sampler = TailSampler(sink=client_sink, max_pending=256,
+                          latency_source=lambda: float(budget_ms),
+                          head_rate=0.0).attach()
+    cli = qrpc.RpcClient({n: ("127.0.0.1", p) for n, p in ports.items()},
+                         retries=0, hedge=False, timeout_ms=5_000.0,
+                         seed=4)
+    errors = {}
+    try:
+        _wait_fleet_up(cli, names)
+        futs = []
+        t0 = time.perf_counter()
+        for k in range(n_req):
+            target = t0 + k / rate_rps
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futs.append(cli.lookup_future(k % 50))
+        ok = 0
+        for fut in futs:
+            try:
+                fut.result(timeout=60)
+                ok += 1
+            except qrpc.RpcError as e:
+                errors[type(e).__name__] = \
+                    errors.get(type(e).__name__, 0) + 1
+        st = sampler.stats()
+    finally:
+        sampler.detach()
+        tracing.disable()
+        tracing.clear()
+        tracing.set_replica(None)
+        cli.close()
+        client_sink.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+    store = TraceStore(capacity=4096)
+    for src, path in [("client", client_path)] + list(sinks.items()):
+        for rec in read_jsonl(path):
+            if rec.get("kind") == "trace":
+                store.add(rec, src)
+    assembled = store.assembled()
+    slow = [t for t in assembled if "latency_over_p99" in t["policies"]]
+    errs = [t for t in assembled if "error" in t["policies"]]
+    cross_slow = [t for t in slow if len(t["segments"]) >= 2
+                  and t.get("dominant")]
+    cross_err = [t for t in errs if len(t["segments"]) >= 2]
+    interesting = {p: st["kept_by_policy"].get(p, 0)
+                   for p in ("error", "deadline_exceeded",
+                             "latency_over_p99")}
+    healthy_kept = st["kept"] - sum(interesting.values())
+    healthy = st["completed"] - st["kept"] + healthy_kept
+    fails = []
+    if not cross_slow:
+        fails.append("seeded SLOW request never assembled across "
+                     "client + replica with a dominant span")
+    if not cross_err:
+        fails.append("seeded ERROR request never assembled across "
+                     "client + replica")
+    if healthy and healthy_kept > 0.01 * healthy:
+        fails.append(f"healthy-trace drop rate below 99% "
+                     f"({healthy_kept}/{healthy} kept)")
+    if st["pending_high_water"] > st["pending_capacity"]:
+        fails.append("pending-table high-water exceeded its capacity")
+    rec = {
+        "requests": n_req,
+        "accepted": ok,
+        "client_errors": errors,
+        "assembled_traces": len(assembled),
+        "cross_process_slow": len(cross_slow),
+        "cross_process_error": len(cross_err),
+        "slow_dominant": (cross_slow[0]["dominant"]
+                          if cross_slow else None),
+        "client_sampler": st,
+        "failures": fails,
+    }
+    return rec, fails
+
+
 def accuracy_tradeoff(qv, jax, engine, n_nodes, probes=512, reps=2):
     """Argmax agreement of each fanout variant against the variant-0
     reference on a fixed probe set (plus variant 0 against itself — the
@@ -703,6 +944,11 @@ def main():
                     help="run ONLY the chaos kill A/B (real serve "
                          "replicas unless --smoke) — the chip_suite "
                          "`chaos` section")
+    ap.add_argument("--tail-only", action="store_true",
+                    help="run ONLY the tail-sampling fleet validation "
+                         "(seeded slow+error requests through 3 real "
+                         "replicas, assembled-trace checks) — the "
+                         "chip_suite `trace` section")
     ap.add_argument("--replica", action="store_true",
                     help="run as ONE serve replica (spawned by the "
                          "chaos supervisor, not by hand)")
@@ -732,6 +978,23 @@ def main():
 
     jax = configure_jax()
     import quiver_tpu as qv
+
+    if args_cli.tail_only:
+        t_start = time.time()
+        res, fails = tail_fleet(args_cli.budget_ms)
+        rec = {
+            "metric": TAIL_METRIC,
+            "value": res["assembled_traces"],
+            "unit": "traces",
+            "platform": ("cpu-smoke"
+                         if platform in ("cpu", "default") else platform),
+            "tail_fleet": res,
+            "elapsed_s": round(time.time() - t_start, 1),
+        }
+        _emit(rec)
+        for f in fails:
+            print(f"TAIL FAIL: {f}", file=sys.stderr)
+        return 1 if fails else 0
 
     if args_cli.chaos_only:
         t_start = time.time()
@@ -902,6 +1165,10 @@ def main():
     fleet_ab = fleet_plane_ab(qv, co_engine, co_cfg, ab_rate, trial_s,
                               n_nodes, best_of, budget_ms)
 
+    # -- always-on tail sampler A/B (attached vs detached) -------------------
+    tail = tail_ab(qv, co_engine, co_cfg, ab_rate, trial_s, n_nodes,
+                   best_of, budget_ms)
+
     # -- chaos kill A/B (smoke only here: jax-free fake replicas prove
     # the harness + JSON contract; the comparable real-replica number
     # comes from `--chaos-only`, chip_suite's `chaos` section) --------------
@@ -937,6 +1204,12 @@ def main():
         fanout_argmax_agreement=agree,
         trace_ab=trace_ab,
         fleet_ab=fleet_ab,
+        tail_ab=tail,
+        # bench_regress trajectory keys: the always-on sampler's
+        # throughput ratio (higher is better, ~1.0 = free) and the
+        # kept fraction (LOWER is better — keep-everything is drift)
+        tail_rps_ratio=tail["rps_ratio"],
+        tail_kept_frac=tail["kept_frac"],
         sweep=sweep,
         trials={"serial": serial_trials, "coalesced": co_trials},
         elapsed_s=round(time.time() - t_start, 1),

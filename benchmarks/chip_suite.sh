@@ -25,7 +25,7 @@ export QT_METRICS_JSONL
 SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-verify prof fleet chaos bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
+SECTIONS="${*:-verify prof fleet chaos trace bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -75,6 +75,17 @@ fi
 # claims the chip).
 if want chaos; then
     step env JAX_PLATFORMS=cpu python -u benchmarks/bench_serving.py --chaos-only
+fi
+
+# tail-sampled tracing (qt-tail): 3 REAL serve replicas each running
+# an always-on TailSampler into their heartbeat sink, a tracing RPC
+# client, and two seeded mid-load faults (one delayed batch, one
+# errored batch) — the verdict checks both traces were KEPT and
+# ASSEMBLED across client + replica segments with the dominant span
+# identified, while healthy traces drop. CPU-only like
+# verify/prof/fleet/chaos (never claims the chip).
+if want trace; then
+    step env JAX_PLATFORMS=cpu python -u benchmarks/bench_serving.py --tail-only
 fi
 
 # metric of record: the full default sweep (pair/sort, overlap/sort,

@@ -54,6 +54,7 @@ from .pipeline import Pipeline, pipelined
 from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
                       ServeEngine, build_serve_step)
+from .tailsampling import TailSampler, TraceStore
 from .telemetry import FlightRecorder, PlanContext, TelemetryHub
 from .profile import StageProfiler, machine_probe
 from .fleet import (FleetAggregator, FleetExporter, HealthRouter,
@@ -63,7 +64,7 @@ from .rpc import (RpcClient, RpcError, RpcServer, DeadlineExceeded,
                   ServerClosed)
 from . import (analysis, comm, profiling, checkpoint, datasets, debug,
                faults, fleet, metrics, profile, rpc, serving,
-               telemetry, tracing)
+               tailsampling, telemetry, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -131,6 +132,8 @@ __all__ = [
     "ServeConfig",
     "ServeEngine",
     "build_serve_step",
+    "TailSampler",
+    "TraceStore",
     "TelemetryHub",
     "PlanContext",
     "FlightRecorder",

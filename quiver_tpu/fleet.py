@@ -70,6 +70,7 @@ import weakref
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import metrics as _metrics
+from .tailsampling import TraceStore
 from .telemetry import TelemetryHub
 
 __all__ = ["FleetAggregator", "FleetExporter", "HealthRouter",
@@ -161,7 +162,7 @@ class FleetAggregator:
                  stale_after_s: Optional[float] = None,
                  sink=None, capacity: int = 512, window: int = 8,
                  kinds: Sequence[str] = TelemetryHub.INGEST_KINDS,
-                 clock=None):
+                 trace_capacity: int = 256, clock=None):
         if isinstance(replicas, dict):
             items = list(replicas.items())
         else:
@@ -185,6 +186,12 @@ class FleetAggregator:
                 (n, _Replica(n, p, capacity, window)) for n, p in items)
         self.anomalies: "collections.deque" = collections.deque(
             maxlen=64)
+        # the fleet trace assembler (qt-tail): per-replica `trace`
+        # records (kept by each replica's TailSampler) stitch by the
+        # propagated global trace_id — client RPC spans + replica
+        # serve spans in one assembled record; bounded LRU, and
+        # `latest()` is what the /metrics exemplars point at
+        self.traces = TraceStore(capacity=trace_capacity)
         self.polls = 0
         self.poll_errors = 0
         # observers called with each poll's snapshot AFTER every lock
@@ -219,6 +226,11 @@ class FleetAggregator:
                                     "replica") if k in rec}
             elif kind == "serving":
                 r.last_serving = rec
+            elif kind == "trace":
+                # TraceStore.add dedups by (source, root), so the
+                # whole-file re-read every poll folds each kept trace
+                # exactly once
+                self.traces.add(rec, r.name)
         n = r.hub.ingest_records(recs, r.path, self.kinds)
         self.fleet.ingest_records(recs, f"{r.name}:{r.path}",
                                   self.kinds)
@@ -802,6 +814,14 @@ def _fmt_value(v: float) -> str:
 def prometheus_text(agg: FleetAggregator) -> str:
     """Render the aggregator's state in Prometheus text exposition
     format (version 0.0.4 — what a ``/metrics`` scrape returns):
+    see :func:`_prometheus_text_ex` for the body."""
+    return _prometheus_text_ex(agg)[0]
+
+
+def _prometheus_text_ex(agg: FleetAggregator) -> Tuple[str, bool]:
+    """:func:`prometheus_text` plus whether an exemplar was stamped
+    (computed AT the stamp — the exporter's content-type switch must
+    not sniff the text, where a series name could fake a match):
 
     - ``qt_replica_health`` / ``qt_replica_stale`` /
       ``qt_replica_age_seconds`` / ``qt_replica_records_total``
@@ -820,6 +840,7 @@ def prometheus_text(agg: FleetAggregator) -> str:
     produce an invalid exposition."""
     snap = agg.snapshot()
     lines: List[str] = []
+    stamped = [False]
 
     def head(name, typ, help_):
         lines.append(f"# HELP {name} {help_}")
@@ -865,17 +886,28 @@ def prometheus_text(agg: FleetAggregator) -> str:
     head("qt_series", "gauge",
          "Last value of each telemetry series (no replica label = "
          "the fleet-global fold).")
+    traces = getattr(agg, "traces", None)
 
     def series_lines(hub, replica: Optional[str]):
         label = (f'replica="{_prom_escape(replica)}",'
                  if replica is not None else "")
+        # OpenMetrics exemplar on latency series: the newest KEPT
+        # trace for this replica — the path from a bad p99 sample to
+        # the exact request behind it (`qt_trace --trace-id`). The
+        # exemplar's own value is that trace's duration_ms.
+        ex = traces.latest(replica) if traces is not None else None
         for sname in sorted(hub.series):
             last = hub.series[sname].last()
             if last is None:
                 continue
-            lines.append(f'qt_series{{{label}name="'
-                         f'{_prom_escape(sname)}"}} '
-                         f'{_fmt_value(last)}')
+            line = (f'qt_series{{{label}name="'
+                    f'{_prom_escape(sname)}"}} '
+                    f'{_fmt_value(last)}')
+            if ex is not None and sname.endswith("_ms"):
+                line += (f' # {{trace_id="{int(ex[0])}"}} '
+                         f'{_fmt_value(ex[1])}')
+                stamped[0] = True
+            lines.append(line)
 
     for name in agg.replica_names:
         series_lines(agg.replica_hub(name), name)
@@ -898,7 +930,11 @@ def prometheus_text(agg: FleetAggregator) -> str:
     for name in agg.replica_names:
         counter_lines(agg.replica_hub(name), name)
     counter_lines(agg.fleet, None)
-    return "\n".join(lines) + "\n"
+    # the OpenMetrics terminator: required once the exposition carries
+    # exemplar syntax (the exporter then declares the OpenMetrics
+    # content type); a plain comment to the classic 0.0.4 parser
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n", stamped[0]
 
 
 # -- the export endpoint ------------------------------------------------------
@@ -908,9 +944,12 @@ class FleetExporter:
     """Stdlib HTTP endpoint over a :class:`FleetAggregator`:
 
     - ``GET /metrics`` — :func:`prometheus_text` (content type
-      ``text/plain; version=0.0.4``). If the aggregator has no
-      background thread running, the scrape itself polls — scrape-time
-      aggregation is the Prometheus-idiomatic mode.
+      ``text/plain; version=0.0.4``, switching to
+      ``application/openmetrics-text`` once kept-trace exemplars
+      appear — exemplar syntax belongs to that grammar). If the
+      aggregator has no background thread running, the scrape itself
+      polls — scrape-time aggregation is the Prometheus-idiomatic
+      mode.
     - ``GET /healthz`` — the fleet verdict as JSON (the aggregator
       snapshot). HTTP 200 while at least one replica is alive
       (``ok``/``degraded``), 503 when the whole fleet is stale
@@ -969,10 +1008,16 @@ class FleetExporter:
         if path == "/metrics":
             if not self.agg.running:
                 self.agg.poll()
-            body = prometheus_text(self.agg).encode()
+            text, has_exemplar = _prometheus_text_ex(self.agg)
+            body = text.encode()
             handler.send_response(200)
+            # exemplar syntax is OpenMetrics, not classic 0.0.4: the
+            # moment a kept trace stamps one, the declared format must
+            # follow, or a strict scraper drops the whole exposition
             handler.send_header(
                 "Content-Type",
+                "application/openmetrics-text; version=1.0.0; "
+                "charset=utf-8" if has_exemplar else
                 "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
             if not self.agg.running:

@@ -49,9 +49,12 @@ per-trajectory-group verdicts), ``profile``
 (``quiver_tpu.profile.StageProfiler`` / ``scripts/qt_prof.py`` —
 per-entry stage timings, modeled bytes, roofline efficiency),
 ``meta`` (:class:`MetricsSink`'s self-attribution header — host, pid,
-start_ts, replica), and ``fleet`` (``quiver_tpu.fleet`` — per-replica
+start_ts, replica), ``fleet`` (``quiver_tpu.fleet`` — per-replica
 health scores + fleet-global rollup from the cross-process
-aggregator). Consumers key on ``kind`` and must ignore unknown fields;
+aggregator), and ``trace`` (``quiver_tpu.tailsampling.TailSampler`` —
+one KEPT request trace: the keep policy, the span timeline, the
+critical-path attribution). Consumers key on ``kind`` and must ignore
+unknown fields;
 ``scripts/lint.sh`` pins that every kind and every counter slot has a
 row in docs/observability.md.
 """
@@ -342,6 +345,15 @@ class StepStats:
                 self._pending.append(counters)
                 if len(self._pending) > self._fold_every:
                     self._fold_locked(keep=1)
+
+    def request_p99_ms(self) -> Optional[float]:
+        """The live per-request p99 in ms (None before any request) —
+        the observed window the tail sampler's ``latency_over_p99``
+        policy reads (``tailsampling.latency_source_from``)."""
+        with self._lock:
+            if not self._req_hist.n:
+                return None
+            return 1e3 * self._req_hist.quantile(0.99)
 
     def record_request(self, duration_s: float) -> None:
         """File one PER-REQUEST latency (admission -> result) — the

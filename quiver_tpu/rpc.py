@@ -70,7 +70,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import faults
+from . import faults, tracing
 
 __all__ = ["RpcError", "DeadlineExceeded", "AttemptTimeout",
            "Overloaded", "ServerClosed", "ReplicaUnavailable",
@@ -629,7 +629,39 @@ class RpcClient:
     async def _call_replica(self, name: str, node: int,
                             budget_ms: Optional[float],
                             ctx: Optional[dict],
-                            timeout_s: float) -> np.ndarray:
+                            timeout_s: float,
+                            tid: Optional[int] = None,
+                            hedge: bool = False) -> np.ndarray:
+        # with tracing on, each dispatch leaves an `rpc.attempt` (or
+        # `rpc.hedge`) span under the request's trace_id — retries and
+        # hedge races are visible per replica in the assembled trace
+        if tid is None:
+            return await self._call_replica_raw(name, node, budget_ms,
+                                                ctx, timeout_s)
+        t0 = time.perf_counter()
+        span = "rpc.hedge" if hedge else "rpc.attempt"
+        try:
+            row = await self._call_replica_raw(name, node, budget_ms,
+                                               ctx, timeout_s)
+        except asyncio.CancelledError:
+            # a cancelled hedge loser is NOT an outcome — the winner's
+            # span tells the request's story; recording
+            # error=CancelledError here would make the tail sampler's
+            # `error` policy keep every hedge-raced SUCCESS
+            raise
+        except BaseException as e:
+            tracing.record(span, t0, time.perf_counter() - t0, tid,
+                           {"replica": name,
+                            "error": type(e).__name__})
+            raise
+        tracing.record(span, t0, time.perf_counter() - t0, tid,
+                       {"replica": name})
+        return row
+
+    async def _call_replica_raw(self, name: str, node: int,
+                                budget_ms: Optional[float],
+                                ctx: Optional[dict],
+                                timeout_s: float) -> np.ndarray:
         conn = await self._conn_of(name)
         msg = {"op": "lookup", "id": next(self._ids), "node": int(node)}
         if budget_ms is not None:
@@ -651,7 +683,8 @@ class RpcClient:
                        remaining_ms: Optional[float],
                        ctx: Optional[dict],
                        causes: List[BaseException],
-                       dispatched: List[str]) -> np.ndarray:
+                       dispatched: List[str],
+                       tid: Optional[int] = None) -> np.ndarray:
         """One attempt = a primary call plus (optionally) one hedge to
         the next-ranked replica once the hedge delay passes unanswered.
         First answer wins; the loser is cancelled (idempotent serve
@@ -663,7 +696,7 @@ class RpcClient:
         if remaining_ms is not None:
             timeout_s = min(timeout_s, max(remaining_ms, 1.0) / 1e3)
         primary = asyncio.ensure_future(self._call_replica(
-            names[0], node, remaining_ms, ctx, timeout_s))
+            names[0], node, remaining_ms, ctx, timeout_s, tid))
         dispatched.append(names[0])
         tasks = {primary: names[0]}
         if self.hedge and len(names) > 1:
@@ -676,7 +709,7 @@ class RpcClient:
                            else max(remaining_ms - delay * 1e3, 1.0))
                 hedge = asyncio.ensure_future(self._call_replica(
                     names[1], node, left_ms, ctx,
-                    max(timeout_s - delay, 1e-3)))
+                    max(timeout_s - delay, 1e-3), tid, hedge=True))
                 dispatched.append(names[1])
                 tasks[hedge] = names[1]
         pending = set(tasks)
@@ -702,6 +735,34 @@ class RpcClient:
 
     async def _lookup(self, node: int, budget_ms: Optional[float],
                       ctx: Optional[dict]) -> np.ndarray:
+        if not tracing.enabled():
+            return await self._lookup_inner(node, budget_ms, ctx, None)
+        # the client's ROOT span (`rpc.lookup`) closes the trace on
+        # this side of the wire — the tail sampler's completion
+        # signal; a failed lookup closes it error-stamped, so the
+        # client keeps exactly the traces its user saw fail
+        c = tracing.extract(ctx)
+        tid = c.trace_id if c is not None else tracing.new_global_trace_id()
+        t0 = time.perf_counter()
+        try:
+            row = await self._lookup_inner(node, budget_ms, ctx, tid)
+        except asyncio.CancelledError:
+            # a cancelled lookup (caller cancelled the future, client
+            # shutting down) is NOT a failed request — no root span,
+            # or the `error` policy would keep every such trace
+            raise
+        except BaseException as e:
+            tracing.record("rpc.lookup", t0, time.perf_counter() - t0,
+                           tid, {"node": int(node),
+                                 "error": type(e).__name__})
+            raise
+        tracing.record("rpc.lookup", t0, time.perf_counter() - t0, tid,
+                       {"node": int(node)})
+        return row
+
+    async def _lookup_inner(self, node: int, budget_ms: Optional[float],
+                            ctx: Optional[dict],
+                            tid: Optional[int]) -> np.ndarray:
         t0 = time.perf_counter()
         deadline = (None if budget_ms is None
                     else t0 + float(budget_ms) / 1e3)
@@ -726,7 +787,7 @@ class RpcClient:
             dispatched: List[str] = []
             try:
                 row = await self._attempt(names, node, remaining_ms,
-                                          ctx, causes, dispatched)
+                                          ctx, causes, dispatched, tid)
                 with self._lock:
                     self._lat_ms.append(
                         (time.perf_counter() - t0) * 1e3)
@@ -750,7 +811,12 @@ class RpcClient:
                         max((deadline - time.perf_counter()) * 1e3
                             - 1.0, 0.0))
                 if delay_ms > 0:
+                    t_back = time.perf_counter()
                     await asyncio.sleep(delay_ms / 1e3)
+                    if tid is not None:
+                        tracing.record("rpc.backoff", t_back,
+                                       time.perf_counter() - t_back,
+                                       tid, {"attempt": attempt})
         with self._lock:
             self._errors["AllAttemptsFailed"] += 1
         raise AllAttemptsFailed(
@@ -766,6 +832,17 @@ class RpcClient:
         :class:`RpcError`."""
         if self._closed:
             raise ServerClosed("rpc client is closed")
+        if tracing.enabled():
+            # mint + inject a global trace context so the replica's
+            # serve spans and this client's rpc spans share one
+            # trace_id — the fleet assembler's stitch key. Caller
+            # metadata without a context gets stamped into a COPY
+            # (the caller's dict is not ours to mutate); a context
+            # the caller already injected passes through untouched.
+            if context is None:
+                context = tracing.inject({})
+            elif tracing.extract(context) is None:
+                context = tracing.inject(dict(context))
         with self._lock:
             self._stats["requests"] += 1
         return asyncio.run_coroutine_threadsafe(

@@ -686,6 +686,15 @@ class MicroBatchServer:
                 self.slo.record(ok=False)
             with self._counts_lock:
                 self._counts["deadline_expired"] += 1
+            if tracing.enabled() and req.trace_id is not None:
+                # the request's TERMINAL span, error-stamped: a shed
+                # request still completes its trace, so the tail
+                # sampler can keep it (deadline_exceeded policy)
+                now = time.perf_counter()
+                tracing.record("serve.request", req.t_enq,
+                               now - req.t_enq, req.trace_id,
+                               {"node": req.node_id,
+                                "error": "DeadlineExceeded"})
         return True
 
     def _coalesce_loop(self):
@@ -813,9 +822,16 @@ class MicroBatchServer:
         caller-side ``cancel()``; a future ``submit``'s close-race
         handler already failed counts as handled (``_fail_future``)."""
         failed = 0
+        traced = tracing.enabled()
+        now = time.perf_counter() if traced else 0.0
         for req in batch:
             if _fail_future(req.future, exc_type(msg)):
                 failed += 1
+                if traced and req.trace_id is not None:
+                    tracing.record("serve.request", req.t_enq,
+                                   now - req.t_enq, req.trace_id,
+                                   {"node": req.node_id,
+                                    "error": exc_type.__name__})
         if failed:
             if self.slo is not None:
                 for _ in range(failed):
@@ -848,6 +864,18 @@ class MicroBatchServer:
                     self.slo.record(ok=False)
             with self._counts_lock:
                 self._counts["failed"] += len(batch)
+            if tracing.enabled():
+                # error-stamped terminal spans: the failed requests'
+                # traces complete with the outcome, so the tail
+                # sampler's `error` policy keeps exactly these
+                now = time.perf_counter()
+                for req in batch:
+                    if req.trace_id is not None:
+                        tracing.record("serve.request", req.t_enq,
+                                       now - req.t_enq, req.trace_id,
+                                       {"batch": bid,
+                                        "node": req.node_id,
+                                        "error": type(e).__name__})
             raise
         done = time.perf_counter()
         traced = tracing.enabled() and bid is not None

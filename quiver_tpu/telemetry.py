@@ -424,6 +424,11 @@ class TelemetryHub:
         self._ingest_marks: Dict[str, tuple] = {}
         self.anomalies: "collections.deque" = collections.deque(
             maxlen=int(max_log))
+        # observers called with each anomaly record OUTSIDE the hub
+        # lock (the same discipline as sink emission) — how the tail
+        # sampler arms its keep-everything window on a detector firing
+        # (``TailSampler.watch_hub``)
+        self.on_anomaly: List[Callable[[dict], None]] = []
         self.advice: Dict[str, dict] = {}
         # detector firings queue here under the lock and emit AFTER it
         # releases — a slow sink disk must never stall every thread
@@ -506,20 +511,27 @@ class TelemetryHub:
             "step": self._series(name).total,
         }
         self.anomalies.append(rec)
-        if self.sink is not None:
+        if self.sink is not None or self.on_anomaly:
             self._emit_queue.append((rec, "anomaly"))
 
     def _drain_emits(self) -> None:
         """Emit queued records OUTSIDE the hub lock (call after every
         lock release that may have fired a detector)."""
-        if self.sink is None:
+        if self.sink is None and not self.on_anomaly:
             return
         with self._lock:
             if not self._emit_queue:
                 return
             queued, self._emit_queue = self._emit_queue, []
         for rec, kind in queued:
-            self.sink.emit(rec, kind=kind)
+            if self.sink is not None:
+                self.sink.emit(rec, kind=kind)
+            if kind == "anomaly":
+                for cb in list(self.on_anomaly):
+                    try:
+                        cb(rec)
+                    except Exception:
+                        pass
 
     def observe(self, name: str, value) -> None:
         """Append one host scalar to series ``name`` (``None``/NaN

@@ -172,6 +172,11 @@ class Tracer:
         self._ids = itertools.count(1)
         self._tid_names: Dict[int, str] = {}
         self._enabled = False
+        # optional tail sampler (quiver_tpu.tailsampling.TailSampler):
+        # every recorded span is ALSO offered to it — the always-on
+        # keep/drop decision rides the same one recording path, one
+        # attribute check when absent
+        self._sampler = None
 
     # -- switch -------------------------------------------------------------
     @property
@@ -233,6 +238,9 @@ class Tracer:
         ring = self._ring
         ring[next(self._seq) % len(ring)] = (
             name, tid, t0, dur, trace_id, args)
+        s = self._sampler
+        if s is not None:
+            s.offer(name, tid, t0, dur, trace_id, args)
 
     def span(self, name: str, trace_id: Optional[int] = None,
              args: Optional[dict] = None):
@@ -241,6 +249,16 @@ class Tracer:
         if not self._enabled:
             return _NULL_SPAN
         return _Span(self, name, trace_id, args)
+
+    def set_sampler(self, sampler) -> None:
+        """Attach (or, with ``None``, detach) a tail sampler — an
+        object whose ``offer(name, tid, t0, dur, trace_id, args)`` is
+        called for every recorded span. ``tailsampling.TailSampler``
+        is the in-tree one; ``clear()`` leaves the attachment alone."""
+        self._sampler = sampler
+
+    def sampler(self):
+        return self._sampler
 
     # -- reading / export ---------------------------------------------------
     def __len__(self) -> int:

@@ -162,17 +162,25 @@ def is_skipped(rec):
 #: LOWER-is-better (see ``INVERTED_METRICS``): accepted-p99 ratio
 #: under a seeded kill, typed-error rate, kill->staleness detection
 #: latency, kill->serving-again recovery time.
+#: ``tail_rps_ratio`` (qt-tail's always-on-vs-detached completed-rps
+#: ratio from ``bench_serving.py``'s ``tail_ab`` block) joins in
+#: round 17 — the sampler's overhead claim, regression-tracked; its
+#: sibling ``tail_kept_frac`` (fraction of traces KEPT) is
+#: LOWER-is-better: a growing kept fraction means the keep policies
+#: drifted toward full capture.
 SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
                "cold_staged_rows_per_s", "gather_efficiency",
                "chaos_accepted_p99_ratio", "chaos_error_rate",
-               "chaos_detection_s", "chaos_recovery_s")
+               "chaos_detection_s", "chaos_recovery_s",
+               "tail_rps_ratio", "tail_kept_frac")
 
 #: trajectory groups where LOWER is better: "best prior" is the
 #: minimum, and the regression rule inverts — the latest value more
 #: than ``threshold`` ABOVE the best prior (plus the metric's
 #: absolute slack) fails the sweep.
 INVERTED_METRICS = ("chaos_accepted_p99_ratio", "chaos_error_rate",
-                    "chaos_detection_s", "chaos_recovery_s")
+                    "chaos_detection_s", "chaos_recovery_s",
+                    "tail_kept_frac")
 
 #: per-metric absolute slack for the inverted rule: several of these
 #: bottom out at 0.0 (a chaos run with EVERY request recovered records
@@ -183,7 +191,11 @@ INVERTED_METRICS = ("chaos_accepted_p99_ratio", "chaos_error_rate",
 INVERTED_ABS_SLACK = {"chaos_error_rate": 0.02,
                       "chaos_detection_s": 0.5,
                       "chaos_recovery_s": 2.0,
-                      "chaos_accepted_p99_ratio": 0.75}
+                      "chaos_accepted_p99_ratio": 0.75,
+                      # a healthy run keeps only the p99-busting tail
+                      # (~1-3%); the slack absorbs box-noise latency
+                      # keeps without letting "keep everything" pass
+                      "tail_kept_frac": 0.05}
 
 
 def _points(rec):

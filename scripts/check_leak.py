@@ -93,6 +93,16 @@ shard-retry) reuses already-compiled programs, and the injections are
 counted (``io_retries`` / ``faults_injected`` /
 ``staging_worker_restarts`` slots), never silent.
 
+Phase 12 pins TAIL SAMPLING (qt-tail): always-on tracing with a
+``TailSampler`` attached, driven by bursty serving traffic whose
+in-flight trace count EXCEEDS the pending-table capacity — so the
+LRU eviction path (the bounded-memory guarantee) is what actually
+runs, counted, while every request still completes its keep/drop
+decision. The sampler is host-side by construction; this phase makes
+it a measured fact: zero executable growth, zero recompiles through
+the server's own watch, flat live arrays, the tracer ring within its
+capacity, and the pending high-water never past the configured bound.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -939,6 +949,77 @@ def main():
     shutil.rmtree(ftmp, ignore_errors=True)
     print("no leak detected (phase 11: active storage-fault plan — "
           "flat executables, zero recompiles, faults counted)")
+
+    # ---- phase 12: always-on tail sampling under eviction pressure ----
+    # The pending-trace table is sized BELOW the in-flight trace count
+    # (bursts of 24 against capacity 8), so the LRU eviction path IS
+    # the test: memory stays bounded by construction, evictions are
+    # counted, every request still completes its keep/drop decision,
+    # and the whole sampler costs zero executables/recompiles (it
+    # never enters jit).
+    from quiver_tpu.tailsampling import TailSampler
+
+    PENDING_CAP = 8
+    ring_cap = 256
+    tracing.enable(capacity=ring_cap)
+    tail_sink_path = os.path.join(tempfile.mkdtemp(), "tail.jsonl")
+    tail_sink = qm.MetricsSink(tail_sink_path)
+    sampler = TailSampler(sink=tail_sink, max_pending=PENDING_CAP,
+                          latency_source=lambda: 1e9,  # nothing slow
+                          head_rate=0.05, seed=3).attach()
+    tserver = MicroBatchServer(engine, ServeConfig(
+        max_wait_ms=1.0, queue_depth=256, shed_queue_frac=0.5))
+    # settle with the sampler already attached
+    for f in [tserver.submit(int(i)) for i in rng.integers(0, n, 24)]:
+        f.result(timeout=60)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = sum(f._cache_size() for f in engine.jitted_fns)
+
+    served = 0
+    for _ in range(20):
+        futs = [tserver.submit(int(i))
+                for i in rng.integers(0, n, 24)]       # 24 > cap of 8
+        for f in futs:
+            assert np.isfinite(f.result(timeout=60)).all()
+        served += len(futs)
+    snap = tserver.snapshot()
+    st = sampler.stats()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = sum(f._cache_size() for f in engine.jitted_fns) - base_cache
+    print(f"phase 12 live arrays: {base_arrays} -> {arrays}; "
+          f"tail-sampled executable-cache growth: {grew}; "
+          f"recompiles: {snap['recompiles']}; sampler: "
+          f"{st['kept']} kept / {st['dropped']} dropped / "
+          f"{st['evicted']} evicted, high-water "
+          f"{st['pending_high_water']}/{st['pending_capacity']}")
+    assert st["evicted"] > 0, \
+        "phase premise: bursts must overflow the pending table"
+    assert st["completed"] >= served, \
+        "requests completed without a keep/drop decision"
+    assert st["pending_high_water"] <= PENDING_CAP, \
+        "pending-trace table exceeded its configured capacity"
+    assert st["kept"] > 0, \
+        "phase premise: the head-sampling floor must keep a few"
+    assert len(tracing.get_tracer()) <= ring_cap, \
+        "tracer ring exceeded its capacity under tail sampling"
+    assert grew == 0, "tail sampling compiled something"
+    assert snap["recompiles"] == 0, \
+        "recompile watch fired under tail sampling"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak under always-on tail sampling"
+    with open(tail_sink_path) as f:
+        kinds = [_json.loads(l)["kind"] for l in f if l.strip()]
+    assert all(k in ("meta", "trace") for k in kinds) and \
+        "trace" in kinds, f"unexpected sink kinds: {set(kinds)}"
+    sampler.detach()
+    tracing.disable()
+    tracing.clear()
+    tserver.close()
+    tail_sink.close()
+    print("no leak detected (phase 12: always-on tail sampling with "
+          "the pending table under eviction pressure)")
 
 
 if __name__ == "__main__":

@@ -95,16 +95,20 @@ def _fleet_table(snap, color):
     return "\n".join(lines)
 
 
-# one exposition line: name{labels} value  (HELP/TYPE lines aside)
+# one exposition line: name{labels} value, with an optional
+# OpenMetrics exemplar suffix (` # {trace_id="..."} value [ts]`) —
+# qt-tail stamps latency series with the newest kept trace
 _PROM_LINE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+$")
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [0-9.eE+-]+"
+    r"( # \{[^{}]*\} [0-9.eE+-]+( [0-9.eE+-]+)?)?$")
 
 
 def check_exposition(text):
     """Minimal Prometheus text-format validation (what the smoke
     gate asserts): every non-comment line matches the
-    ``name{labels} value`` grammar and every sample's metric name was
-    declared by a ``# TYPE`` line. Returns the list of violations."""
+    ``name{labels} value`` grammar (an OpenMetrics exemplar suffix is
+    allowed) and every sample's metric name was declared by a
+    ``# TYPE`` line. Returns the list of violations."""
     bad = []
     typed = set()
     for ln in text.splitlines():

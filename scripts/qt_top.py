@@ -77,10 +77,11 @@ def _num(v):
 
 def build_series(records):
     """kind-keyed record stream -> {series name: [values]} plus the
-    event lists (anomalies, advice, regress, lint, profile, slo,
-    fleet)."""
+    event lists (anomalies, advice, regress, lint, profile, traces,
+    slo, fleet)."""
     series = {}
     anomalies, advice, regress, lint, prof = [], {}, {}, {}, {}
+    traces = {}
     slo = None
     fleet = None
 
@@ -146,7 +147,14 @@ def build_series(records):
             # latest per (rule, entry) — repeated suite runs re-emit
             # the same finding and must not flood the display window
             lint[(rec.get("rule", "?"), rec.get("entry", "?"))] = rec
-    return series, anomalies, advice, regress, lint, prof, slo, fleet
+        elif kind == "trace":
+            # latest per trace_id (the lint/profile dedup discipline):
+            # a trace kept on both sides of the wire lands twice with
+            # the same id and must render as ONE row
+            if rec.get("trace_id") is not None:
+                traces[rec["trace_id"]] = rec
+    return (series, anomalies, advice, regress, lint, prof, traces,
+            slo, fleet)
 
 
 def sparkline(values, width):
@@ -205,8 +213,8 @@ def render(path, limit, width, color=True, fleet_only=False):
     c = (lambda code, s: f"{code}{s}{RESET}") if color else \
         (lambda code, s: s)
     records = read_records(path, limit)
-    series, anomalies, advice, regress, lint, prof, slo, fleet = \
-        build_series(records)
+    (series, anomalies, advice, regress, lint, prof, traces, slo,
+     fleet) = build_series(records)
     lines = [c(BOLD, f"qt_top — {path}  "
                      f"({len(records)} records, "
                      f"{time.strftime('%H:%M:%S')})")]
@@ -280,6 +288,19 @@ def render(path, limit, width, color=True, fleet_only=False):
                        f"{st.get('mean_ms', 0)} ms  "
                        f"{st.get('achieved_gbps', 0)} GB/s  "
                        f"{eff_s}  {share_s}"))
+    # trace panel: the latest kept traces, newest last (record order);
+    # error-kept red, the rest yellow — the rows qt_trace expands
+    for rec in list(traces.values())[-6:]:
+        dom = rec.get("dominant") or {}
+        dom_s = (f"{dom.get('name')} {dom.get('dur_ms', 0)}ms"
+                 if dom else "n/a")
+        bad = rec.get("policy") in ("error", "deadline_exceeded")
+        lines.append(c(RED if bad else YELLOW,
+                       f"  trace {rec.get('trace_id')} "
+                       f"[{rec.get('policy')}] "
+                       f"{rec.get('duration_ms', 0)} ms  "
+                       f"{rec.get('replica', '')}  "
+                       f"dominant {dom_s}"))
     for (metric, platform) in sorted(regress):
         rec = regress[(metric, platform)]
         bad = bool(rec.get("regressed"))

@@ -192,6 +192,16 @@ def test_bench_serving_smoke_json_contract(tmp_path):
     assert fab["scrape_ok"] is True
     assert fab["fleet_status"] in ("ok", "degraded")
     assert 0.0 <= fab["replica_health"] <= 1.0
+    # the always-on tail-sampler A/B ran both arms, decided every
+    # trace, stayed bounded, and surfaced its bench_regress keys
+    tab = out["tail_ab"]
+    assert tab["detached"]["completed_rps"] > 0
+    assert tab["attached"]["completed_rps"] > 0
+    assert out["tail_rps_ratio"] == tab["rps_ratio"] > 0
+    assert tab["traces_completed"] > 0
+    assert out["tail_kept_frac"] == tab["kept_frac"]
+    assert 0.0 <= tab["kept_frac"] < 1.0         # not full capture
+    assert tab["pending_high_water"] <= tab["pending_capacity"]
     assert isinstance(ov["p99_bounded"], bool)
     # accuracy/fanout tradeoff: full fanout vs itself is the noise
     # floor; every ladder entry reports an agreement fraction

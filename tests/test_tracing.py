@@ -115,6 +115,71 @@ class TestTracer:
         assert len(tracing.get_tracer()) == before
 
 
+class TestContextExtraction:
+    """``extract`` on PARTIAL carriers: a context is whatever subset
+    of the ``qt.*`` keys survived the wire — anything with a usable
+    trace_id is a context, anything without is simply untraced."""
+
+    def test_trace_id_only_no_parent(self):
+        ctx = tracing.extract({"qt.trace_id": 41})
+        assert ctx == tracing.TraceContext(41, None, None)
+
+    def test_trace_id_and_replica_no_parent(self):
+        ctx = tracing.extract({"qt.trace_id": 41, "qt.replica": "r2"})
+        assert ctx.trace_id == 41 and ctx.parent is None
+        assert ctx.replica == "r2"
+
+    def test_string_trace_id_tolerated(self):
+        # JSON round trips through proxies that stringify: "41" is 41
+        assert tracing.extract({"qt.trace_id": "41"}).trace_id == 41
+
+    def test_garbage_is_untraced_not_an_error(self):
+        for bad in (None, [], "x", 7,
+                    {}, {"qt.parent": "serve.request"},
+                    {"qt.trace_id": "not-an-int"},
+                    {"qt.trace_id": None}):
+            assert tracing.extract(bad) is None
+
+    def test_inject_then_partial_strip_round_trips(self):
+        carrier = tracing.inject({}, trace_id=99, parent="rpc.lookup")
+        carrier.pop("qt.parent")                 # a lossy proxy
+        ctx = tracing.extract(carrier)
+        assert ctx.trace_id == 99 and ctx.parent is None
+
+
+def _mint_global_ids(q, k):
+    t = tracing.Tracer(capacity=4)
+    q.put((os.getpid(), [t.new_global_trace_id() for _ in range(k)]))
+
+
+class TestGlobalTraceIds:
+    def test_no_collisions_across_forked_replicas(self):
+        """The pid rides the high bits: fresh tracers in FORKED
+        replicas (each restarting its local counter at 1 — the worst
+        case) must never mint colliding global ids."""
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        k = 200
+        procs = [ctx.Process(target=_mint_global_ids, args=(q, k))
+                 for _ in range(3)]
+        for p in procs:
+            p.start()
+        got = [q.get(timeout=30) for _ in procs]
+        for p in procs:
+            p.join(timeout=30)
+        pids = [pid for pid, _ in got]
+        assert len(set(pids)) == 3               # really forked
+        parent_tracer = tracing.Tracer(capacity=4)
+        own = [parent_tracer.new_global_trace_id() for _ in range(k)]
+        all_ids = own + [i for _, ids in got for i in ids]
+        assert len(set(all_ids)) == len(all_ids) == 4 * k
+        # and every id still extracts through a carrier round trip
+        sample = got[0][1][0]
+        assert tracing.extract(
+            tracing.inject({}, trace_id=sample)).trace_id == sample
+
+
 class TestSloBudget:
     def _budget(self, **kw):
         clock = [1000.0]
