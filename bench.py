@@ -630,6 +630,66 @@ def main():
         gather_efficiency = gather_achieved_gbps / probe_gather_gbps
     except Exception as e:          # the probe must never fail a run
         print(f"machine probe failed: {e!r}", file=sys.stderr)
+
+    # ---- qt-fuse figures: single-kernel sample+gather hop A/B ----
+    # one hop, fused (ops.pallas.fused: picks AND dequantized rows out
+    # of ONE kernel, frontier ids never in HBM) vs split (the sample
+    # kernel then the row gather — the frontier-id HBM round trip).
+    # Two numbers: the steps/s ratio fused/split (timed at one BLOCK
+    # of seeds), and the fused hop's MODELED gather indexing bytes —
+    # zero by construction, verified through the cost model so a
+    # regression that reintroduces an HBM frontier array fails loudly.
+    def measure_fused_ab(reps=5):
+        import numpy as _np
+        from quiver_tpu.analysis.costmodel import cost_of
+        from quiver_tpu.analysis.registry import build_entry_specs
+        from quiver_tpu.ops import quant
+        from quiver_tpu.ops.pallas.fused import (default_interpret,
+                                                 default_rng,
+                                                 fused_hot_hop,
+                                                 fused_hot_hop_reference,
+                                                 pad_indices)
+        index_bytes = int(cost_of(
+            build_entry_specs("fused_hot_hop")[0]).gather_index_bytes)
+        rf = _np.random.default_rng(18)
+        n_f, dim_f, bs_f, k_f, cap_f = 4096, 128, 128, 4, 128
+        deg_f = rf.integers(0, 24, n_f)
+        ip = _np.zeros(n_f + 1, _np.int64)
+        ip[1:] = _np.cumsum(deg_f)
+        ip = jnp.asarray(ip.astype(_np.int32))
+        ix = pad_indices(jnp.asarray(
+            rf.integers(0, n_f, int(deg_f.sum())).astype(_np.int32)),
+            cap_f)
+        fq = quant.quantize(jnp.asarray(
+            rf.standard_normal((n_f, dim_f)).astype(_np.float32)),
+            "int8")
+        sds = jnp.asarray(
+            rf.choice(n_f, bs_f, replace=False).astype(_np.int32))
+        rng_f, interp = default_rng(), default_interpret()
+
+        def run_pair(fn):
+            jax.block_until_ready(fn(jnp.int32(0)))     # compile
+            t0 = time.perf_counter()
+            for r in range(reps):
+                out = fn(jnp.int32(r + 1))
+            jax.block_until_ready(out)
+            return reps / (time.perf_counter() - t0)
+
+        fused_sps = run_pair(lambda s: fused_hot_hop(
+            ip, ix, sds, fq, k_f, s, row_cap=cap_f, rng=rng_f,
+            interpret=interp))
+        split_sps = run_pair(lambda s: fused_hot_hop_reference(
+            ip, ix, sds, fq, k_f, s, row_cap=cap_f, rng=rng_f,
+            interpret=interp))
+        return fused_sps / split_sps, index_bytes
+
+    fused_vs_split_steps_per_s = None
+    fused_gather_index_bytes = None
+    try:
+        (fused_vs_split_steps_per_s,
+         fused_gather_index_bytes) = measure_fused_ab()
+    except Exception as e:          # the A/B must never fail a run
+        print(f"fused hop A/B failed: {e!r}", file=sys.stderr)
     stage_ms = {
         "sample": round(sample_ms_per_batch, 3),
         "gather": round(gather_ms_per_batch, 3),
@@ -702,6 +762,15 @@ def main():
                                  if gather_achieved_gbps is not None
                                  else None),
         "probe_gather_gbps": probe_gather_gbps,
+        # qt-fuse: fused/split steps-per-second ratio for one
+        # sample+gather hop, and the fused hop's modeled gather
+        # indexing bytes (0 = frontier ids never touch HBM;
+        # bench_regress tracks it inverted so any nonzero value — a
+        # reintroduced frontier round trip — fails the sweep)
+        "fused_vs_split_steps_per_s":
+            (round(fused_vs_split_steps_per_s, 4)
+             if fused_vs_split_steps_per_s is not None else None),
+        "fused_gather_index_bytes": fused_gather_index_bytes,
         "stage_ms": stage_ms,
         "stage_shares": stage_shares,
     }

@@ -25,7 +25,7 @@ export QT_METRICS_JSONL
 SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-verify prof fleet chaos trace bench dispatch sampler gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
+SECTIONS="${*:-verify prof fleet chaos trace bench dispatch sampler fuse gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -108,6 +108,16 @@ if want sampler; then
     step python -u benchmarks/bench_sampler.py --hop1 rotation
     step python -u benchmarks/bench_sampler.py --hop1 wexact
     step python -u benchmarks/bench_sampler.py --hop1 wwindow
+fi
+
+# fused single-kernel sample+gather hop (qt-fuse): bit equivalence vs
+# the split two-program oracle, fused/split steps-per-s ratio, modeled
+# gather_index_bytes=0. Runs on the chip; the CPU interpret-mode A/B
+# (the equivalence half on any box) is exercised by the fuse section's
+# second line — keep both lines green
+if want fuse; then
+    step python -u benchmarks/bench_fused.py
+    step env JAX_PLATFORMS=cpu python -u benchmarks/bench_fused.py --iters 2
 fi
 
 # feature gather GB/s: raw device + pallas (128-aligned and padded)

@@ -131,6 +131,84 @@ def _dot_flops(eqn) -> int:
     return 2 * out * k
 
 
+def _pallas_spec_bytes(eqn) -> int:
+    """DMA bytes of one ``pallas_call`` from its grid/block specs — the
+    fallback pricing for kernels that publish no ``cost_estimate``.
+    Blocked operands stream ``grid-steps x block`` bytes; ``ANY``-space
+    operands (kernel-managed DMA, e.g. a whole CSR or feature table the
+    kernel slices itself) are charged one full read — an upper bound for
+    row-sparse kernels, but the model must not claim traffic below what
+    the specs prove."""
+    gm = eqn.params.get("grid_mapping")
+    if gm is None:
+        return sum(_nbytes(v.aval) for v in list(eqn.invars)
+                   + list(eqn.outvars) if not isinstance(v, _Literal))
+    try:
+        steps = int(np.prod([int(g) for g in gm.grid])) if gm.grid else 1
+    except TypeError:        # dynamic grid dim — floor at one pass
+        steps = 1
+    n_out = int(getattr(gm, "num_outputs", 0) or 0)
+    bms = list(gm.block_mappings)
+    total = 0
+    for bm in bms[:len(bms) - n_out] if n_out else bms:
+        sds = bm.array_shape_dtype
+        full = int(np.prod(sds.shape)) * np.dtype(sds.dtype).itemsize
+        if "any" in str(getattr(bm, "transformed_block_aval",
+                                "")).lower():
+            total += full
+            continue
+        blk = np.dtype(sds.dtype).itemsize
+        for b, s in zip(bm.block_shape, sds.shape):
+            try:
+                blk *= int(s if b is None else b)
+            except TypeError:
+                blk *= int(s)
+        total += steps * blk
+    # outputs are written once in full (blocked out specs tile them)
+    total += sum(_nbytes(v.aval) for v in eqn.outvars)
+    return total
+
+
+def _pallas_tier_rows(jaxpr, shape, dt) -> int:
+    """Rows a ``pallas_call`` kernel reads from a tier leaf of
+    ``(shape, dt)`` — the structural analogue of ``gather_reads`` for
+    fused kernels, so ``tier_bytes`` stays a model output when the
+    gather moves inside a kernel. Heuristic: when the leaf feeds a
+    pallas_call as an operand, every float matrix OUTPUT whose row
+    width matches the leaf's row width is one DMA'd tier row per row
+    (exact for the fused hot-hop kernel, whose feature outputs are
+    dequantized copies of the rows it pulled; sidecar leaves — row
+    width 1 — match no output and price 0, an accepted undercount of
+    8 B/row)."""
+    jxp = _as_jaxpr(jaxpr)
+    width = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+    rows = 0
+    for eqn in jxp.eqns:
+        if eqn.primitive.name == "pallas_call":
+            feeds = any(
+                not isinstance(v, _Literal)
+                and tuple(getattr(v.aval, "shape", ())) == tuple(shape)
+                and v.aval.dtype == dt
+                for v in eqn.invars)
+            if feeds and width > 1:
+                for ov in eqn.outvars:
+                    a = ov.aval
+                    if (len(a.shape) >= 2
+                            and np.issubdtype(a.dtype, np.floating)
+                            and int(np.prod(a.shape[1:])) == width):
+                        rows += int(a.shape[0])
+            continue
+        for k in ("jaxpr", "call_jaxpr", "fun_jaxpr", "body_jaxpr",
+                  "cond_jaxpr"):
+            sub = eqn.params.get(k)
+            if sub is not None and (hasattr(sub, "jaxpr")
+                                    or hasattr(sub, "eqns")):
+                rows += _pallas_tier_rows(sub, shape, dt)
+        for br in eqn.params.get("branches", ()) or ():
+            rows += _pallas_tier_rows(br, shape, dt)
+    return rows
+
+
 class _CostWalk:
     """One recursive pricing pass; gather-operand vars and index vars
     are tracked across the whole walk and resolved through reshape/
@@ -194,6 +272,33 @@ class _CostWalk:
             elif name in COLLECTIVE_PRIMS:
                 cost["collective_bytes"] += mult * _nbytes(
                     eqn.invars[0].aval)
+
+            elif name == "pallas_call":
+                # price the kernel's DMA traffic instead of recursing
+                # into its body (the body jaxpr operates on refs — its
+                # "gathers" are VMEM addressing, not HBM traffic, and
+                # the old generic recursion mispriced them). Every
+                # operand is kernel-consumed: streamed by block specs
+                # or DMA'd row-wise, never ALSO a full input read.
+                for v in eqn.invars:
+                    if not isinstance(v, _Literal):
+                        self.gather_operands.add(self._origin(v))
+                ce = eqn.params.get("cost_estimate")
+                if ce is not None:
+                    # the kernel author's exact traffic model (the
+                    # fused sample+gather hop publishes one) — and NO
+                    # index bytes: frontier ids that stay in VMEM are
+                    # exactly the traffic gather_index_bytes exists to
+                    # expose, so a fused kernel reports 0 here as a
+                    # model output, not an assertion
+                    cost["flops"] += mult * int(
+                        getattr(ce, "flops", 0) or 0)
+                    cost["gather_bytes"] += mult * int(
+                        getattr(ce, "bytes_accessed", 0) or 0)
+                else:
+                    cost["gather_bytes"] += mult * _pallas_spec_bytes(
+                        eqn)
+                continue
 
             if name == "cond":
                 branches = []
@@ -290,6 +395,9 @@ def cost_of_jaxpr(jaxpr, tiers: Tuple = ()) -> CostModel:
             width = int(np.prod(shape[1:])) * dt.itemsize
             rows = sum(r for r, d in gather_reads(jaxpr, shape, dt)
                        if d == 0)
+            # gathers fused into a Pallas kernel leave no gather eqn —
+            # recover their tier rows structurally
+            rows += _pallas_tier_rows(jxp, shape, dt)
             key = f"{tuple(shape)}:{dt}"
             model.tier_bytes[key] = (model.tier_bytes.get(key, 0)
                                      + rows * width)

@@ -302,9 +302,57 @@ def _dist_train_step() -> EntrySpec:
                   exchange=exchange, detail=detail)]
 
 
+def _fused_hot_hop() -> List[EntrySpec]:
+    import numpy as np
+    import jax.numpy as jnp
+    from ..ops import quant
+    from ..ops.pallas.fused import (default_interpret, fused_hot_hop,
+                                    pad_indices)
+    fx = _fixture()
+    k, row_cap = 4, 64
+    rng = np.random.default_rng(3)
+    # dedicated lane-aligned table: per-row feature DMAs need the row
+    # width to be a multiple of 128 (the fixture's dim-16 table would
+    # trip the full-table pad cliff on every call)
+    wide = jnp.asarray(
+        rng.standard_normal((fx.n, 128)).astype(np.float32))
+    feat_q = quant.quantize(wide, "int8")
+    idx = pad_indices(fx.indices, row_cap)
+    interpret = default_interpret()
+
+    def make(feat):
+        def fn(indptr, indices_padded, seeds, seed):
+            # the portable "hash" rng: the entry is executable (the
+            # profiler runs registry entries) and bit-compatible with
+            # the split oracle on every backend
+            return fused_hot_hop(indptr, indices_padded, seeds, feat,
+                                 k, seed, row_cap=row_cap, rng="hash",
+                                 interpret=interpret)
+        return fn
+
+    args = (fx.indptr, idx, fx.seeds, jnp.int32(7))
+    # rows the kernel DMAs from the tier per call: one padded seed
+    # block plus its picks (no gather eqn exists to meter — the budget
+    # bounds the structural _pallas_tier_rows count via costmodel)
+    budget = 128 * (1 + k)
+    return [
+        EntrySpec(
+            name="fused_hot_hop", fn=make(feat_q), args=args,
+            tier_budgets=((feat_q, budget, 0),),
+            census=CensusSpec({"variant": ("quantized", "plain")},
+                              max_programs=2),
+            detail={"k": k, "row_cap": row_cap, "rng": "hash"}),
+        # the plain-f32 tier variant is its own program — trace it too
+        # so both census points are actually verified
+        EntrySpec(
+            name="fused_hot_hop[plain]", fn=make(wide), args=args,
+            tier_budgets=((wide, budget, 0),))]
+
+
 register_entry("train_step", _train_step, quick=True)
 register_entry("lookup_tiered", _lookup_tiered, quick=True)
 register_entry("dist_lookup", _dist_lookup, quick=True)
 register_entry("serve_step", _serve_step, quick=True)
+register_entry("fused_hot_hop", _fused_hot_hop, quick=True)
 register_entry("e2e_train_step", _e2e_train_step)
 register_entry("dist_train_step", _dist_train_step)

@@ -22,7 +22,20 @@ The kernels stay importable and interpret-mode-tested (they mirror the
 jnp correctness oracles, and ``bench_sampler.py --pallas`` /
 ``bench_feature.py --pallas`` stay wired in ``chip_suite.sh``), so
 the moment hardware returns the decision can be revisited with
-numbers. They are NOT on any production call path.
+numbers. ``sample_kernel.py`` and ``gather.py`` are NOT on any
+production call path.
+
+Round 18 (qt-fuse) adds the exception: ``fused.py`` fuses the hop walk
+and the hot-tier feature gather into ONE kernel, so the frontier id
+list never round-trips through HBM between a sample program and a
+gather program — something no jnp graph can express (XLA materializes
+the ids between the two gathers). It IS reachable from production
+builders, strictly opt-in: ``build_train_step(fused_hot_hop=True)`` /
+``build_serve_step(fused_hot_hop=True)`` / ``ServeEngine``, single-hop
+exact method only, with the jnp split path as the default and the
+bit-equivalence oracle (``fused_hot_hop_reference``, pinned in
+``tests/test_fused.py``). Shared DMA/window/PRNG helpers for all three
+kernels live in ``_dma.py``.
 """
 
 __all__ = []

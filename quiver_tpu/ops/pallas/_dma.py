@@ -1,0 +1,141 @@
+"""Shared Mosaic DMA/window math + pluggable kernel PRNG.
+
+One home for the alignment rules every HBM-streaming kernel in this
+package must agree on (three hand-copies of the rule is how the next
+kernel gets it wrong — ISSUE 16 satellite):
+
+- ``ALIGN``/``win``/``pad_indices``: HBM DMA starts must be lane-aligned
+  (Mosaic rejects unaligned HBM slices — learned from the gather
+  kernel's first on-chip compile), so row reads start at the enclosing
+  128-aligned address and cover ``row_cap + ALIGN`` entries; the
+  <=127-entry residual shifts the position compare instead of the DMA.
+- ``align_start``: the align-down + residual split itself.
+- ``pad_feature_dim``: per-row feature DMAs need the row width to be a
+  multiple of 128 lanes; tables that are not get zero-padded with a
+  trace-time warning (a full-table HBM copy per call — hot paths should
+  store tables pre-padded).
+
+``make_rand_bits`` is the kernels' PRNG provider. Two interchangeable
+backends drawing identical *roles* (a uint32 vector per call):
+
+  "tpu"   the on-core generator (``pltpu.prng_seed`` +
+          ``prng_random_bits``) — the production TPU path. This jax
+          pins no CPU interpret lowering for those primitives, so
+          kernels built with it are TPU-only.
+  "hash"  a pure-jnp counter-based Wang/Murmur-style integer mix —
+          interprets everywhere AND compiles on TPU. Deterministic in
+          (seed, block, call index), so two kernels seeded alike draw
+          identical streams: this is what makes the fused kernel's
+          bit-equivalence tests vs the two-program oracle runnable on
+          CPU (the acceptance gate of ISSUE 16).
+
+Both backends are seeded per grid block (``seed + block`` for "tpu", a
+block-salted hash for "hash") so blocks draw independent streams.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+# lane alignment for HBM DMA starts; the staging window is
+# row_cap + ALIGN wide everywhere (pad, kernel, scratch)
+ALIGN = 128
+
+RNGS = ("tpu", "hash")
+
+
+def win(row_cap: int) -> int:
+    """Staging-window width for a ``row_cap`` neighbor read: the
+    aligned start can sit up to ALIGN-1 entries before the true one."""
+    return row_cap + ALIGN
+
+
+def pad_indices(indices: jax.Array, row_cap: int) -> jax.Array:
+    """Append ``win(row_cap)`` sentinel entries so the aligned-start
+    row DMAs (start rounded down to 128, window ``win`` wide) can
+    overread safely."""
+    return jnp.concatenate(
+        [indices, jnp.zeros((win(row_cap),), indices.dtype)])
+
+
+def align_start(start):
+    """Split an HBM element offset into (128-aligned start, residual).
+
+    Works on traced scalars and vectors alike; the residual is < ALIGN
+    and shifts the in-window position compare."""
+    aligned = (start // ALIGN) * ALIGN
+    return aligned, start - aligned
+
+
+def pad_feature_dim(feat: jax.Array, op: str = "gather"):
+    """Zero-pad a feature table's row width up to the next multiple of
+    128 lanes (per-row HBM DMA requirement). Emits a trace-time warning
+    when it fires: the pad is a full-table HBM copy PER CALL — a
+    hot-path cliff callers should avoid by storing tables pre-padded."""
+    out_dim = feat.shape[1]
+    if out_dim % 128:
+        import warnings
+        warnings.warn(
+            f"{op}: feature dim {out_dim} is not a multiple of 128 — "
+            "padding the whole table on every call (full-table HBM "
+            "copy). Store the table pre-padded to avoid this.",
+            stacklevel=3)
+        feat = jnp.pad(feat, ((0, 0), (0, 128 - out_dim % 128)))
+    return feat
+
+
+def _mix_u32(x):
+    """Wang-style 32-bit integer finalizer (full avalanche)."""
+    x = (x ^ jnp.uint32(61)) ^ (x >> 16)
+    x = x * jnp.uint32(9)
+    x = x ^ (x >> 4)
+    x = x * jnp.uint32(0x27D4EB2D)
+    x = x ^ (x >> 15)
+    return x
+
+
+def make_rand_bits(rng: str, seed, blk):
+    """Return ``rand_bits(bs) -> uint32[bs]``, the kernels' draw op.
+
+    ``seed`` is a traced int32 scalar, ``blk`` the grid block id. The
+    returned callable must be invoked the same number of times in the
+    same order by any two kernels that are meant to draw identical
+    streams (the call index is part of the "hash" backend's counter).
+    """
+    if rng == "tpu":
+        pltpu.prng_seed(seed + blk)
+
+        def rand_bits(bs: int):
+            return pltpu.bitcast(
+                pltpu.prng_random_bits((1, bs)), jnp.uint32)[0]
+
+        return rand_bits
+    if rng == "hash":
+        base = _mix_u32(
+            seed.astype(jnp.uint32)
+            ^ (jnp.uint32(0x9E3779B9) * (blk.astype(jnp.uint32) + 1)))
+        state = {"step": 0}
+
+        def rand_bits(bs: int):
+            step = state["step"]
+            state["step"] += 1
+            lane = jax.lax.broadcasted_iota(jnp.uint32, (1, bs), 1)[0]
+            x = (base ^ (lane * jnp.uint32(0x85EBCA6B))
+                 ^ jnp.uint32((step * 0x9E3779B9) & 0xFFFFFFFF))
+            return _mix_u32(_mix_u32(x))
+
+        return rand_bits
+    raise ValueError(f"unknown kernel rng {rng!r}; expected one of {RNGS}")
+
+
+def default_rng() -> str:
+    """"tpu" on TPU backends (on-core generator), "hash" elsewhere
+    (this jax cannot interpret the pltpu prng primitives on CPU)."""
+    return "tpu" if jax.default_backend() == "tpu" else "hash"
+
+
+def default_interpret() -> bool:
+    """Interpret mode everywhere but on a real TPU backend."""
+    return jax.default_backend() != "tpu"

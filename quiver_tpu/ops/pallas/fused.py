@@ -1,0 +1,471 @@
+"""Fused single-kernel sample+gather hot hop — frontier ids stay in VMEM.
+
+Sampling and feature lookup are two separate XLA programs on the jnp
+path, with the frontier ids materialized as an HBM array between them —
+the exact seam the paper's warp-per-seed sampler + warp-per-row gather
+design exists to hide (and the one the sample-and-aggregate fusion line,
+arxiv 2209.02916, and C-SAW's sample-then-collect pipeline, 2009.06693,
+attack by keeping picks on-chip). PR 12 priced that seam:
+``costmodel.gather_index_bytes`` counts 2,080 B of pure frontier-id
+traffic per train_step batch.
+
+This kernel walks ONE hop for a block of 128 seeds and gathers the
+feature rows of every seed and every pick before returning:
+
+  phase A (sample, per block)
+    - DMA each seed's ``indptr`` pair HBM->SMEM (degrees/starts are
+      computed in-kernel — the wrapper issues NO gather, which is what
+      makes ``gather_index_bytes=0`` a verifiable model output);
+    - DMA each seed's CSR neighbor row HBM->VMEM at the 128-aligned
+      start (``_dma`` rules), residual shifting the position compare;
+    - the ``sample_kernel`` vectorized partial Fisher-Yates picks k
+      positions per seed ([BLOCK, k] lanes, pluggable PRNG);
+    - iota-compare extraction materializes picks + counts.
+  phase B (gather, same kernel invocation)
+    - the picks are DMA'd VMEM->SMEM once (SMEM is the scalar-
+      addressable space; frontier ids never leave the core);
+    - a double-buffered pipeline (the ``gather`` kernel's _N_BUF scheme)
+      DMAs each of the BLOCK*(1+k) hot-tier rows — int8 codes plus the
+      fp32 scale/zero sidecars for a quantized tier — and applies the
+      folded ``code * scale + zero`` FMA in-register (bit-identical to
+      ``quant.gather_rows``), multiply-masking invalid (-1 / cold) rows
+      to zero exactly like ``masked_feature_gather``.
+
+Scope and contract:
+
+- single hop, hot tier only. Picks whose storage row falls outside
+  ``hot_rows`` (cold tier) come back zero-masked alongside valid=False
+  semantics; callers route them to the unchanged tiered lookup.
+- ``row_cap`` truncation is inherited from ``sample_kernel``: rows with
+  degree > row_cap sample uniformly from their first row_cap neighbors.
+- with ``rng="hash"`` the kernel is bit-identical, under interpret mode,
+  to the two-program oracle (``sample_layer_pallas`` with the same rng
+  + ``quant.gather_rows``) — ``fused_hot_hop_reference`` below IS that
+  oracle. "tpu" rng swaps in the on-core generator (TPU-only).
+- ``feature_order`` (old id -> storage row) is translated in-kernel via
+  serial 1-element DMAs — correct and interpret-validated, but a known
+  TPU-hardening cost cliff; all-hot identity-order stores skip it.
+
+CPU-interpret-validated behind a TPU flag (``interpret`` defaults to
+True off-TPU), per ROADMAP item 2's scoping.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..._compat import pallas_tpu_compiler_params as _compiler_params
+from .. import quant
+from . import _dma
+from ._dma import align_start, make_rand_bits, pad_feature_dim
+from .sample_kernel import BLOCK, _fy_positions
+from .sample_kernel import sample_layer_pallas
+
+# feature-row DMA pipeline depth (the gather kernel's scheme)
+_N_BUF = 4
+
+# re-exported so callers configure the fused path without reaching into
+# _dma (shared spelling lives there)
+default_rng = _dma.default_rng
+default_interpret = _dma.default_interpret
+pad_indices = _dma.pad_indices
+
+
+def _make_fused_kernel(*, k, row_cap, rng, n_nodes, n_order, tier_n,
+                       hot_rows, dim, out_dt, quantized, has_forder):
+    win = _dma.win(row_cap)
+    n_rows = BLOCK * (1 + k)        # seeds first, then flattened picks
+
+    def kernel(*refs):
+        it = iter(refs)
+        seeds_smem = next(it)
+        seed_ref = next(it)
+        indptr_hbm = next(it)
+        indices_hbm = next(it)
+        data_hbm = next(it)
+        scale_hbm = next(it) if quantized else None
+        zero_hbm = next(it) if quantized else None
+        forder_hbm = next(it) if has_forder else None
+        nbrs_ref = next(it)
+        cnt_ref = next(it)
+        seed_rows_ref = next(it)
+        pick_rows_ref = next(it)
+        ptr_smem = next(it)
+        ptr_sems = next(it)
+        rows_vmem = next(it)
+        row_sems = next(it)
+        picks_smem = next(it)
+        pick_sem = next(it)
+        code_vmem = next(it)
+        feat_sems = next(it)
+        if quantized:
+            scale_smem = next(it)
+            zero_smem = next(it)
+            scale_sems = next(it)
+            zero_sems = next(it)
+        if has_forder:
+            tid_smem = next(it)
+            tid_sem = next(it)
+
+        blk = pl.program_id(0)
+        rand_bits = make_rand_bits(rng, seed_ref[0], blk)
+
+        # ---- phase A: sample (degrees/starts resolved IN-KERNEL) ----
+        def seed_ptr(i):
+            return jnp.clip(seeds_smem[i], 0, n_nodes - 1)
+
+        def ptr_start(i, _):
+            pltpu.make_async_copy(
+                indptr_hbm.at[pl.ds(seed_ptr(i), 2)],
+                ptr_smem.at[i], ptr_sems.at[i]).start()
+            return 0
+
+        jax.lax.fori_loop(0, BLOCK, ptr_start, 0)
+
+        def row_start_of(i):
+            # same semantics as the split wrapper: invalid seeds read
+            # degree 0 at start 0
+            valid = seeds_smem[i] >= 0
+            start = jnp.where(valid, ptr_smem[i, 0], 0)
+            return align_start(start)[0]
+
+        b_iota = jax.lax.broadcasted_iota(jnp.int32, (1, BLOCK), 1)
+
+        def row_start(i, carry):
+            degv, offv = carry
+            pltpu.make_async_copy(
+                indptr_hbm.at[pl.ds(seed_ptr(i), 2)],
+                ptr_smem.at[i], ptr_sems.at[i]).wait()
+            valid = seeds_smem[i] >= 0
+            start = jnp.where(valid, ptr_smem[i, 0], 0)
+            deg = jnp.where(valid, ptr_smem[i, 1] - ptr_smem[i, 0], 0)
+            aligned, off = align_start(start)
+            pltpu.make_async_copy(
+                indices_hbm.at[pl.ds(aligned, win)],
+                rows_vmem.at[i], row_sems.at[i]).start()
+            onehot = b_iota == i
+            return (jnp.where(onehot, deg, degv),
+                    jnp.where(onehot, off, offv))
+
+        degv, offv = jax.lax.fori_loop(
+            0, BLOCK, row_start,
+            (jnp.zeros((1, BLOCK), jnp.int32),
+             jnp.zeros((1, BLOCK), jnp.int32)))
+        degs = degv[0]
+        offs = offv[0]
+
+        pos = _fy_positions(degs, k, row_cap, rand_bits)  # [BLOCK, k]
+
+        def row_wait(i, _):
+            pltpu.make_async_copy(
+                indices_hbm.at[pl.ds(row_start_of(i), win)],
+                rows_vmem.at[i], row_sems.at[i]).wait()
+            return 0
+
+        jax.lax.fori_loop(0, BLOCK, row_wait, 0)
+
+        rows = rows_vmem[:, :]                            # [BLOCK, win]
+        r_iota = jax.lax.broadcasted_iota(jnp.int32, (BLOCK, win), 1)
+        counts = jnp.minimum(degs, k).astype(jnp.int32)
+        shifted = pos + offs[:, None]                     # window coords
+        for i in range(k):
+            sel = jnp.sum(
+                jnp.where(r_iota == shifted[:, i][:, None], rows, 0),
+                axis=1)
+            valid_i = i < counts
+            nbrs_ref[:, i] = jnp.where(valid_i, sel.astype(jnp.int32), -1)
+        cnt_ref[0] = counts
+
+        # ---- phase B: gather (frontier ids never leave the core) ----
+        # picks to SMEM once — the scalar-addressable space the DMA
+        # engine can take row addresses from
+        cp = pltpu.make_async_copy(nbrs_ref, picks_smem, pick_sem)
+        cp.start()
+        cp.wait()
+
+        def raw_id(i):
+            i = jnp.asarray(i, jnp.int32)
+            is_seed = i < BLOCK
+            si = jnp.where(is_seed, i, 0)
+            pi = jnp.where(is_seed, 0, i - BLOCK)
+            prow = pi // k
+            pcol = pi - prow * k
+            return jnp.where(is_seed, seeds_smem[si],
+                             picks_smem[prow, pcol])
+
+        if has_forder:
+            # old id -> storage row, one serial element DMA per row
+            # (documented cost cliff; identity-order stores skip this)
+            def translate(i, _):
+                safe = jnp.clip(raw_id(i), 0, n_order - 1)
+                t = pltpu.make_async_copy(
+                    forder_hbm.at[pl.ds(safe, 1)],
+                    tid_smem.at[pl.ds(i, 1)], tid_sem)
+                t.start()
+                t.wait()
+                return 0
+
+            jax.lax.fori_loop(0, n_rows, translate, 0)
+
+        def srow_valid(i):
+            rid = raw_id(i)
+            if has_forder:
+                tid = tid_smem[jnp.asarray(i, jnp.int32)]
+                return (jnp.clip(tid, 0, tier_n - 1),
+                        (rid >= 0) & (tid < hot_rows))
+            return jnp.clip(rid, 0, tier_n - 1), rid >= 0
+
+        def feat_copies(slot, i):
+            srow = srow_valid(i)[0]
+            cps = [pltpu.make_async_copy(
+                data_hbm.at[srow], code_vmem.at[slot],
+                feat_sems.at[slot])]
+            if quantized:
+                cps.append(pltpu.make_async_copy(
+                    scale_hbm.at[srow], scale_smem.at[slot],
+                    scale_sems.at[slot]))
+                cps.append(pltpu.make_async_copy(
+                    zero_hbm.at[srow], zero_smem.at[slot],
+                    zero_sems.at[slot]))
+            return cps
+
+        for w in range(_N_BUF - 1):                       # warm up
+            for c in feat_copies(w, w):
+                c.start()
+
+        def gather_body(i, _):
+            slot = jax.lax.rem(i, _N_BUF)
+            next_i = i + (_N_BUF - 1)
+
+            @pl.when(next_i < n_rows)
+            def _():
+                for c in feat_copies(jax.lax.rem(next_i, _N_BUF),
+                                     next_i):
+                    c.start()
+
+            for c in feat_copies(slot, i):
+                c.wait()
+            # multiply-mask (NOT select): bit-parity with the oracle's
+            # ``rows * (ids >= 0)`` including -0.0
+            maskv = srow_valid(i)[1].astype(out_dt)
+            code = code_vmem[slot]                        # [dim]
+            if quantized:
+                prod = code.astype(out_dt) * scale_smem[slot, 0]
+                z = zero_smem[slot, 0]
+
+                # two-step store: materializing the product forces the
+                # oracle's mul-then-add rounding — the single-expression
+                # form contracts to a one-rounding FMA under the CPU
+                # backend and drifts 1 ulp from quant.gather_rows
+                def dequant_into(ref, j):
+                    ref[j, :] = prod
+                    ref[j, :] = (ref[j, :] + z) * maskv
+
+                @pl.when(i < BLOCK)
+                def _():
+                    dequant_into(seed_rows_ref, i)
+
+                @pl.when(i >= BLOCK)
+                def _():
+                    dequant_into(pick_rows_ref, i - BLOCK)
+            else:
+                x = code * maskv
+
+                @pl.when(i < BLOCK)
+                def _():
+                    seed_rows_ref[i, :] = x
+
+                @pl.when(i >= BLOCK)
+                def _():
+                    pick_rows_ref[i - BLOCK, :] = x
+
+            return 0
+
+        jax.lax.fori_loop(0, n_rows, gather_body, 0)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "row_cap", "rng", "interpret", "hot_rows"))
+def _fused_hot_hop(indptr, indices_padded, seeds, feat, k, seed,
+                   row_cap, rng, interpret, feature_order, hot_rows):
+    n_nodes = indptr.shape[0] - 1
+    bs = seeds.shape[0]
+    pad = (-bs) % BLOCK
+    if pad:
+        seeds = jnp.concatenate(
+            [seeds, jnp.full((pad,), -1, seeds.dtype)])
+    padded_bs = seeds.shape[0]
+    grid = padded_bs // BLOCK
+    n_rows = BLOCK * (1 + k)
+
+    data, scale, zero = quant.tier_parts(feat)
+    quantized = scale is not None
+    out_dt = quant.tier_dtype(feat)
+    tier_n = quant.tier_rows(feat)
+    out_dim = data.shape[1]
+    data = pad_feature_dim(data, "fused_hot_hop")
+    dim = data.shape[1]
+    has_forder = feature_order is not None
+    n_order = feature_order.shape[0] if has_forder else 0
+    hot = tier_n if hot_rows is None else hot_rows
+
+    kernel = _make_fused_kernel(
+        k=k, row_cap=row_cap, rng=rng, n_nodes=n_nodes, n_order=n_order,
+        tier_n=tier_n, hot_rows=hot, dim=dim, out_dt=out_dt,
+        quantized=quantized, has_forder=has_forder)
+
+    in_specs = [
+        pl.BlockSpec((BLOCK,), lambda b: (b,), memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    operands = [seeds.astype(jnp.int32),
+                jnp.asarray(seed, jnp.int32).reshape(1),
+                indptr.astype(jnp.int32),
+                indices_padded,
+                data]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)] * 2
+        operands += [scale, zero]
+    if has_forder:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        operands.append(feature_order.astype(jnp.int32))
+
+    scratch = [
+        pltpu.SMEM((BLOCK, 2), jnp.int32),        # indptr pairs
+        pltpu.SemaphoreType.DMA((BLOCK,)),
+        pltpu.VMEM((BLOCK, _dma.win(row_cap)), indices_padded.dtype),
+        pltpu.SemaphoreType.DMA((BLOCK,)),
+        pltpu.SMEM((BLOCK, k), jnp.int32),        # picks, on-core
+        pltpu.SemaphoreType.DMA,
+        pltpu.VMEM((_N_BUF, dim), data.dtype),    # feature-row pipeline
+        pltpu.SemaphoreType.DMA((_N_BUF,)),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.SMEM((_N_BUF, 1), out_dt),
+            pltpu.SMEM((_N_BUF, 1), out_dt),
+            pltpu.SemaphoreType.DMA((_N_BUF,)),
+            pltpu.SemaphoreType.DMA((_N_BUF,)),
+        ]
+    if has_forder:
+        scratch += [
+            pltpu.SMEM((n_rows,), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ]
+
+    # exact traffic model for the analysis plane (costmodel prices
+    # pallas_call from this estimate when present): per block — the
+    # indptr pairs, the staged CSR windows, one tier row (codes +
+    # sidecars) per seed/pick, the order translation, and the outputs.
+    idx_item = jnp.dtype(indices_padded.dtype).itemsize
+    out_item = jnp.dtype(out_dt).itemsize
+    bytes_accessed = grid * (
+        BLOCK * 4                                  # seeds (SMEM block)
+        + BLOCK * 2 * 4                            # indptr pairs
+        + BLOCK * _dma.win(row_cap) * idx_item     # CSR staging windows
+        + n_rows * quant.row_read_bytes(feat)      # tier rows
+        + (n_rows * 4 if has_forder else 0)        # order translation
+        + BLOCK * (k + 1) * 4                      # nbrs + counts out
+        + n_rows * dim * out_item)                 # feature rows out
+    flops = 2 * grid * n_rows * dim if quantized else 0
+
+    nbrs, cnt, seed_rows, pick_rows = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((BLOCK, k), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK, dim), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK * k, dim), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_bs, k), jnp.int32),
+            jax.ShapeDtypeStruct((grid, BLOCK), jnp.int32),
+            jax.ShapeDtypeStruct((padded_bs, dim), out_dt),
+            jax.ShapeDtypeStruct((padded_bs * k, dim), out_dt),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=flops, transcendentals=0,
+            bytes_accessed=int(bytes_accessed)),
+        compiler_params=_compiler_params(has_side_effects=True),
+    )(*operands)
+    return (nbrs[:bs], cnt.reshape(-1)[:bs],
+            seed_rows[:bs, :out_dim], pick_rows[:bs * k, :out_dim])
+
+
+def fused_hot_hop(indptr, indices_padded, seeds, feat, k, seed,
+                  row_cap: int = 2048, rng: str | None = None,
+                  interpret: bool | None = None,
+                  feature_order=None, hot_rows: int | None = None):
+    """One fused hop: sample ``k`` neighbors per seed AND gather the
+    hot-tier feature rows of seeds + picks in a single Pallas kernel.
+
+    Returns ``(nbrs [bs,k], counts [bs], seed_rows [bs,d],
+    pick_rows [bs*k,d])`` with ``pick_rows`` flattened row-major over
+    ``nbrs`` and invalid (-1 / cold-tier) rows zero-masked.
+
+    ``feat`` is a plain array or :class:`quant.QuantizedTensor` (the
+    dequant FMA runs in-register); ``feature_order`` an optional
+    old-id -> storage-row map with ``hot_rows`` bounding the hot tier.
+    ``rng`` / ``interpret`` default per backend (``_dma``): the kernel
+    runs interpreted with the portable "hash" PRNG off-TPU.
+    """
+    if rng is None:
+        rng = default_rng()
+    if interpret is None:
+        interpret = default_interpret()
+    return _fused_hot_hop(indptr, indices_padded, seeds, feat, k, seed,
+                          row_cap, rng, interpret, feature_order,
+                          hot_rows)
+
+
+def fused_hot_hop_reference(indptr, indices_padded, seeds, feat, k,
+                            seed, row_cap: int = 2048,
+                            rng: str = "hash",
+                            interpret: bool | None = None,
+                            feature_order=None,
+                            hot_rows: int | None = None):
+    """The split two-program oracle: ``sample_layer_pallas`` (same rng,
+    frontier ids round-tripping through HBM) followed by the jnp
+    ``quant.gather_rows`` path. With ``rng="hash"`` the fused kernel is
+    bit-identical to this under interpret mode — the acceptance gate."""
+    if interpret is None:
+        interpret = default_interpret()
+    nbrs, counts = sample_layer_pallas(
+        indptr, indices_padded, seeds, k, seed, row_cap=row_cap,
+        rng=rng, interpret=interpret)
+
+    def rows_of(ids):
+        tier_n = quant.tier_rows(feat)
+        if feature_order is not None:
+            t = feature_order[jnp.clip(ids, 0,
+                                       feature_order.shape[0] - 1)]
+            hot = tier_n if hot_rows is None else hot_rows
+            valid = (ids >= 0) & (t < hot)
+            safe = jnp.clip(t, 0, tier_n - 1)
+        else:
+            valid = ids >= 0
+            safe = jnp.clip(ids, 0, tier_n - 1)
+        x = quant.gather_rows(feat, safe)
+        return x * valid.astype(x.dtype)[:, None]
+
+    return (nbrs, counts, rows_of(seeds),
+            rows_of(nbrs.reshape(-1).astype(jnp.int32)))

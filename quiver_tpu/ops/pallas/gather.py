@@ -22,6 +22,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..._compat import pallas_tpu_compiler_params as _compiler_params
+from ._dma import pad_feature_dim
 
 # rows of the output processed by one grid step
 _BLOCK_ROWS = 256
@@ -69,17 +70,7 @@ def gather_rows(feat: jax.Array, ids: jax.Array,
     table 128-padded up front and hit the fast branch."""
     b = ids.shape[0]
     out_dim = feat.shape[1]
-    if out_dim % 128:
-        import warnings
-        # trace-time warning (once per shape under jit): the pad is a
-        # full-table HBM copy PER CALL — a hot-path cliff callers should
-        # avoid by storing the table 128-padded up front
-        warnings.warn(
-            f"gather_rows: feature dim {out_dim} is not a multiple of "
-            "128 — padding the whole table on every call (full-table "
-            "HBM copy). Store the table pre-padded to avoid this.",
-            stacklevel=2)
-        feat = jnp.pad(feat, ((0, 0), (0, 128 - out_dim % 128)))
+    feat = pad_feature_dim(feat, "gather_rows")
     dim = feat.shape[1]
     if b % _BLOCK_ROWS:
         pad = _BLOCK_ROWS - b % _BLOCK_ROWS

@@ -8,7 +8,7 @@ The hot-path equivalent of the reference's warp-per-row reservoir kernel
   into a VMEM staging buffer (the TPU analogue of the reference's UVA
   streaming reads).
 - selection is a *vectorized* partial Fisher-Yates over the whole block
-  ([BLOCK, k] lanes in the VPU) using the on-core PRNG — same
+  ([BLOCK, k] lanes in the VPU) using a pluggable PRNG — same
   distribution as the jnp oracle, no atomics, no serial per-row loops.
 - the chosen positions are materialized with an iota-compare reduction
   over the staged rows (VPU), avoiding unsupported dynamic VMEM gathers.
@@ -22,10 +22,16 @@ percentile of the target graphs).
 ``indices`` must be padded with ``row_cap + 128`` trailing entries
 (``pad_indices``) so fixed-size row DMAs never read out of bounds.
 
-Row DMA starts are aligned DOWN to 128 (Mosaic rejects HBM slices that
-are not lane-aligned — learned from the gather kernel's first on-chip
-compile) and the <=127-entry residual offset shifts the position
-compare instead.
+Alignment rules (DMA starts rounded down to 128, residual shifting the
+position compare, the staging-window width) live in ``_dma`` — shared
+with the gather and fused kernels so the Mosaic constraint has exactly
+one spelling.
+
+``rng`` selects the draw backend (``_dma.make_rand_bits``): "tpu" is
+the on-core generator (TPU-only on this jax — no CPU interpret
+lowering), "hash" a pure-jnp counter hash that interprets everywhere
+and draws identical streams across kernels seeded alike (what the
+fused kernel's bit-equivalence oracle runs on).
 """
 
 from __future__ import annotations
@@ -38,29 +44,25 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..._compat import pallas_tpu_compiler_params as _compiler_params
+from . import _dma
+from ._dma import align_start, make_rand_bits
 
 BLOCK = 128
-# lane alignment for HBM DMA starts; the staging window is
-# row_cap + ALIGN wide everywhere (pad, kernel, scratch) — keep in sync
-# via _win()
-ALIGN = 128
+
+# re-exported API (shared spelling lives in _dma)
+ALIGN = _dma.ALIGN
+pad_indices = _dma.pad_indices
 
 
 def _win(row_cap: int) -> int:
-    return row_cap + ALIGN
+    return _dma.win(row_cap)
 
 
-def pad_indices(indices: jax.Array, row_cap: int) -> jax.Array:
-    """Append row_cap + 128 sentinel entries so the aligned-start row
-    DMAs (start rounded down to 128, window row_cap + 128 wide) can
-    overread safely."""
-    return jnp.concatenate(
-        [indices, jnp.zeros((_win(row_cap),), indices.dtype)])
-
-
-def _fy_positions(degs: jax.Array, k: int, row_cap: int):
+def _fy_positions(degs: jax.Array, k: int, row_cap: int, rand_bits):
     """Vectorized partial Fisher-Yates inside the kernel: positions
-    [BLOCK, k] without replacement in [0, min(deg, row_cap))."""
+    [BLOCK, k] without replacement in [0, min(deg, row_cap)).
+    ``rand_bits(bs) -> uint32[bs]`` is the injected draw op (one call
+    per step, so backends with a call counter stay reproducible)."""
     bs = degs.shape[0]
     pool = jnp.minimum(degs, row_cap)                     # candidate pool
     pos_log = jnp.full((bs, k), -1, jnp.int32)
@@ -77,8 +79,7 @@ def _fy_positions(degs: jax.Array, k: int, row_cap: int):
         return jnp.where(last >= 0, logged, x)
 
     for i in range(k):
-        rbits = pltpu.bitcast(
-            pltpu.prng_random_bits((1, bs)), jnp.uint32)[0]
+        rbits = rand_bits(bs)
         span = jnp.maximum(pool - i, 1).astype(jnp.uint32)
         j = (i + (rbits % span)).astype(jnp.int32)
         a_j = lookup(pos_log, val_log, j)
@@ -90,13 +91,13 @@ def _fy_positions(degs: jax.Array, k: int, row_cap: int):
     return jnp.stack(outs, axis=1)                        # [bs, k]
 
 
-def _make_kernel(k: int, row_cap: int):
+def _make_kernel(k: int, row_cap: int, rng: str):
     win = _win(row_cap)     # aligned start + residual offset coverage
 
     def kernel(starts_smem, meta_ref, seed_ref, indices_hbm,
                out_ref, cnt_ref, rows_vmem, sems):
         blk = pl.program_id(0)
-        pltpu.prng_seed(seed_ref[0] + blk)
+        rand_bits = make_rand_bits(rng, seed_ref[0], blk)
 
         # stage BLOCK neighbor rows HBM -> VMEM; starts_smem carries the
         # 128-ALIGNED starts (Mosaic requires lane-aligned HBM slices)
@@ -111,7 +112,7 @@ def _make_kernel(k: int, row_cap: int):
 
         degs = meta_ref[0]                                # [BLOCK]
         offs = meta_ref[1]                                # [BLOCK] < 128
-        pos = _fy_positions(degs, k, row_cap)             # [BLOCK, k]
+        pos = _fy_positions(degs, k, row_cap, rand_bits)  # [BLOCK, k]
 
         def wait_dma(i, _):
             pltpu.make_async_copy(
@@ -138,15 +139,18 @@ def _make_kernel(k: int, row_cap: int):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "row_cap", "interpret"))
+                   static_argnames=("k", "row_cap", "rng", "interpret"))
 def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
                         seeds: jax.Array, k: int, seed,
                         row_cap: int = 2048,
+                        rng: str = "tpu",
                         interpret: bool = False):
     """Drop-in for ``ops.sample.sample_layer`` backed by the TPU kernel.
 
     ``indices_padded`` comes from ``pad_indices``; ``seed`` is a scalar
     int32 (derive from a jax PRNG key via ``jax.random.randint``).
+    ``rng="hash"`` swaps the on-core generator for the portable counter
+    hash (identical draw stream to the fused kernel's — see ``_dma``).
     """
     n = indptr.shape[0] - 1
     bs = seeds.shape[0]
@@ -160,8 +164,7 @@ def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
     starts = jnp.where(valid, indptr[safe], 0).astype(jnp.int32)
     degs = jnp.where(valid, (indptr[safe + 1] - indptr[safe]), 0) \
         .astype(jnp.int32)
-    aligned = (starts // ALIGN) * ALIGN      # lane-aligned DMA starts
-    offs = starts - aligned                  # residual < 128
+    aligned, offs = align_start(starts)      # lane-aligned DMA starts
 
     grid = padded_bs // BLOCK
     # meta rows interleave per block: [degs; offs]
@@ -169,7 +172,7 @@ def sample_layer_pallas(indptr: jax.Array, indices_padded: jax.Array,
                       offs.reshape(grid, BLOCK)], axis=1) \
         .reshape(grid * 2, BLOCK)
     out, cnt = pl.pallas_call(
-        _make_kernel(k, row_cap),
+        _make_kernel(k, row_cap, rng),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((BLOCK,), lambda b: (b,),

@@ -169,6 +169,28 @@ def tier_dtype(t):
     return jnp.dtype(t.dtype)
 
 
+def tier_parts(t):
+    """Split a tier into its storage leaves for kernel plumbing:
+    ``(codes, scale, zero)`` for a quantized tier, ``(t, None, None)``
+    for a plain array. The fused Pallas hop passes these as separate
+    pallas_call operands (a NamedTuple cannot cross the kernel ABI) and
+    applies the same folded ``code * scale + zero`` FMA in-register, so
+    the kernel and :func:`gather_rows` stay bit-identical."""
+    if is_quantized(t):
+        return t.data, t.scale, t.zero
+    return t, None, None
+
+
+def row_read_bytes(t) -> int:
+    """Bytes one row LOOKUP of this tier moves from storage (codes +
+    sidecars for int8, the row itself otherwise) — the per-row DMA cost
+    the fused kernel's CostEstimate and the bench byte models charge."""
+    if is_quantized(t):
+        return int(tier_dim(t) + t.scale.dtype.itemsize
+                   + t.zero.dtype.itemsize)
+    return int(tier_dim(t) * jnp.dtype(t.dtype).itemsize)
+
+
 def tier_key(t):
     """Hashable identity of a tier's stored layout (executable-cache
     keys: shape + every leaf dtype, so an fp32 and an int8 store of the

@@ -232,7 +232,11 @@ class StageProfiler:
         fusion headroom in time; the gather stage's
         ``gather_index_bytes`` is the same headroom in bytes (the
         frontier-id round trip ROADMAP frontier 2's fused kernel
-        deletes)."""
+        deletes). A fourth stage, ``fused_hop``, times the registry's
+        single-kernel Pallas sample+gather hop (``fused_hot_hop`` —
+        one hop at its own fixture shape, so compare its COST model
+        line, ``gather_index_bytes=0``, rather than its wall time
+        against the two-hop stages)."""
         from .analysis.registry import _fixture, build_entry_specs
         from .ops.sample_multihop import sample_multihop
         from .parallel.train import masked_feature_gather
@@ -256,6 +260,12 @@ class StageProfiler:
                          donate_argnums=tuple(step.donate_argnums),
                          cost=cost_of(step)),
         ]
+        fused = build_entry_specs("fused_hot_hop")[0]
+        stages.append(ProfileStage(
+            "fused_hop",
+            fused.fn if hasattr(fused.fn, "_cache_size")
+            else jax.jit(fused.fn),
+            fused.args, cost=cost_of(fused)))
         return self.add_group(ProfileGroup("train_pipeline", stages,
                                            ref_stage="step"))
 

@@ -84,7 +84,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import faults, tracing
-from .parallel.train import (dedup_feature_gather, layers_to_adjs,
+from .parallel.train import (_fused_hot_hop_x, _fused_knobs,
+                             dedup_feature_gather, layers_to_adjs,
                              masked_feature_gather)
 from .profiling import hot_path
 # the typed request-failure vocabulary is shared with the RPC plane
@@ -114,7 +115,12 @@ def build_serve_step(model, sizes: Sequence[int], batch_cap: int,
                      method: str = "exact",
                      dedup_gather=None,
                      gather: Optional[Callable] = None,
-                     collect_metrics: bool = False):
+                     collect_metrics: bool = False,
+                     fused_hot_hop: bool = False,
+                     fused_row_cap: int = 2048,
+                     fused_rng: Optional[str] = None,
+                     fused_interpret: Optional[bool] = None,
+                     fused_hot_rows: Optional[int] = None):
     """Pre-compiled point-inference step for one fanout config.
 
     Returns ``step(params, key, feat, forder, indptr, indices, seeds)``
@@ -135,23 +141,63 @@ def build_serve_step(model, sizes: Sequence[int], batch_cap: int,
     the ``ServeEngine`` uses this to splice a ``Feature`` store's fused
     tiered lookup into the program). The returned step exposes
     ``.jitted_fns`` (for ``StepStats.watch_compiles``) and ``.raw``
-    (the traceable body, for jaxpr pins like ``host_sync_eqns``)."""
+    (the traceable body, for jaxpr pins like ``host_sync_eqns``).
+
+    ``fused_hot_hop=True`` (single-hop ``sizes``, ``method="exact"``)
+    swaps the sample+gather pair for the single-kernel Pallas hop
+    (``ops.pallas.fused``): picks and their dequantized hot-tier rows
+    come out of ONE kernel, frontier ids never touch HBM.
+    ``fused_hot_rows`` scopes the in-kernel gather to the hot tier;
+    when a ``gather`` override is also given (the ``ServeEngine``'s
+    tiered ``Feature`` splice, where ``feat`` is the ``(device_part,
+    host)`` pytree and the kernel reads ``feat[0]``), the slots the
+    kernel masked as cold are overlaid from the store's unchanged
+    tiered lookup afterwards — the fused kernel handles the hot tier
+    only. ``fused_row_cap``/``fused_rng``/``fused_interpret`` are the
+    kernel's knobs (see ``parallel.train.build_train_step``)."""
     sizes = list(sizes)
     if gather is None and dedup_gather is not None:
         budget = None if dedup_gather is True else int(dedup_gather)
         gather = (lambda feat, n_id, forder, collector=None:
                   dedup_feature_gather(feat, n_id, forder, budget,
                                        collector=collector))
+    fused = _fused_knobs(fused_hot_hop, fused_row_cap, fused_rng,
+                         fused_interpret, sizes, method,
+                         dedup_gather=dedup_gather)
+    if fused is not None and gather is not None and fused_hot_rows is None:
+        raise ValueError(
+            "fused_hot_hop over a spliced tiered gather needs "
+            "fused_hot_rows (the hot-tier row count) to route cold "
+            "picks back through the tiered lookup")
 
     @hot_path
     def forward(params, key, feat, forder, indptr, indices, seeds,
                 collector=None):
         key, sub = jax.random.split(key)
-        n_id, layers = sample_multihop_serving(
-            indptr, indices, seeds, sizes, sub, method=method,
-            collector=collector)
-        x = (gather or masked_feature_gather)(feat, n_id, forder,
-                                              collector=collector)
+        if fused is not None:
+            hot = feat[0] if gather is not None else feat
+            x, layers = _fused_hot_hop_x(
+                hot, forder, indptr, indices, seeds, sizes[0], sub,
+                hot_rows=fused_hot_rows, collector=collector, **fused)
+            if gather is not None:
+                # cold fixup: the kernel zeroed every pick whose
+                # translated row falls outside the hot tier; those
+                # slots — and ONLY those — come from the store's
+                # unchanged tiered lookup (hot slots masked to -1 so
+                # the store reads nothing for them)
+                n_id = layers[0].n_id
+                t = forder[jnp.clip(n_id, 0)] if forder is not None \
+                    else jnp.clip(n_id, 0)
+                is_cold = (n_id >= 0) & (t >= fused_hot_rows)
+                x_cold = gather(feat, jnp.where(is_cold, n_id, -1),
+                                forder, collector=collector)
+                x = jnp.where(is_cold[:, None], x_cold, x)
+        else:
+            n_id, layers = sample_multihop_serving(
+                indptr, indices, seeds, sizes, sub, method=method,
+                collector=collector)
+            x = (gather or masked_feature_gather)(feat, n_id, forder,
+                                                  collector=collector)
         adjs = layers_to_adjs(layers, batch_cap, sizes)
         with jax.named_scope("qt_serve_forward"):
             logits = model.apply(params, x, adjs, train=False)
@@ -209,6 +255,12 @@ class ServeEngine:
     ``collect_metrics=True`` makes every ``run`` also emit the device
     counter vector (stashed on ``last_counters``; read it lazily).
 
+    ``fused_hot_hop=True`` (every variant single-hop, exact method)
+    builds each variant on the single-kernel Pallas sample+gather hop:
+    hot-tier rows come straight out of the sampling kernel and only
+    cold picks (when the store is tiered) take the split lookup. See
+    ``build_serve_step``'s knob of the same name.
+
     ``run(seeds, variant=0)`` is NOT thread-safe (the donated key chain
     is serialized state) — the server funnels all dispatches through
     its single pipeline worker; direct callers must do the same.
@@ -221,6 +273,8 @@ class ServeEngine:
                  method: str = "exact",
                  dedup_gather=None,
                  collect_metrics: bool = False,
+                 fused_hot_hop: bool = False,
+                 fused_row_cap: int = 2048,
                  seed: int = 0):
         if not sizes_variants:
             raise ValueError("need at least one fanout variant")
@@ -248,10 +302,23 @@ class ServeEngine:
         self._feat = feat
         self._forder = None if forder is None else \
             jnp.asarray(forder, jnp.int32)
+        fused_kw = {}
+        if fused_hot_hop:
+            hot_rows = None
+            if gather is not None:
+                # tiered store: the kernel reads the (device_part, host)
+                # pytree's hot part; cold picks route back through the
+                # store's own lookup (the serve step's cold fixup)
+                from .ops import quant
+                hot_rows = quant.tier_rows(self._feat[0])
+            fused_kw = dict(fused_hot_hop=True,
+                            fused_row_cap=fused_row_cap,
+                            fused_hot_rows=hot_rows)
         self._steps = [
             build_serve_step(model, sizes, self.batch_cap, method=method,
                              dedup_gather=dedup_gather, gather=gather,
-                             collect_metrics=self.collect_metrics)
+                             collect_metrics=self.collect_metrics,
+                             **fused_kw)
             for sizes in self.variants]
         self._key = jax.random.key(seed)
 

@@ -168,11 +168,20 @@ def is_skipped(rec):
 #: sibling ``tail_kept_frac`` (fraction of traces KEPT) is
 #: LOWER-is-better: a growing kept fraction means the keep policies
 #: drifted toward full capture.
+#: ``fused_vs_split_steps_per_s`` / ``fused_gather_index_bytes``
+#: (qt-fuse's single-kernel sample+gather hop, from ``bench.py`` and
+#: ``benchmarks/bench_fused.py``) join in round 18: the fused/split
+#: throughput ratio (higher is better), and the fused hop's modeled
+#: gather indexing bytes — 0 by construction and LOWER-is-better, so
+#: a regression that reintroduces the frontier-id HBM round trip
+#: (any nonzero value) fails the sweep.
 SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
                "cold_staged_rows_per_s", "gather_efficiency",
                "chaos_accepted_p99_ratio", "chaos_error_rate",
                "chaos_detection_s", "chaos_recovery_s",
-               "tail_rps_ratio", "tail_kept_frac")
+               "tail_rps_ratio", "tail_kept_frac",
+               "fused_vs_split_steps_per_s",
+               "fused_gather_index_bytes")
 
 #: trajectory groups where LOWER is better: "best prior" is the
 #: minimum, and the regression rule inverts — the latest value more
@@ -180,7 +189,7 @@ SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
 #: absolute slack) fails the sweep.
 INVERTED_METRICS = ("chaos_accepted_p99_ratio", "chaos_error_rate",
                     "chaos_detection_s", "chaos_recovery_s",
-                    "tail_kept_frac")
+                    "tail_kept_frac", "fused_gather_index_bytes")
 
 #: per-metric absolute slack for the inverted rule: several of these
 #: bottom out at 0.0 (a chaos run with EVERY request recovered records

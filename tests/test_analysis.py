@@ -479,7 +479,26 @@ class TestCleanPass:
         errors = [str(f) for f in findings if f.level == ERROR]
         assert errors == []
         assert set(ran) >= {"train_step", "lookup_tiered",
-                            "dist_lookup", "serve_step"}
+                            "dist_lookup", "serve_step",
+                            "fused_hot_hop"}
+
+    def test_fused_hot_hop_entry(self):
+        # the fused sample+gather kernel's contract, as cost-model
+        # output: the entry traces sync-free, its census enumerates
+        # both storage variants, and the FUSED hop moves ZERO gather
+        # indexing bytes while the split train step's frontier-id
+        # round trip prices at 2080 B — the exact traffic the kernel
+        # deletes
+        specs = registry.build_entry_specs("fused_hot_hop")
+        assert len(specs) == specs[0].census.count() == 2
+        from quiver_tpu.analysis.costmodel import cost_of
+        fused_cost = cost_of(specs[0])
+        assert fused_cost.gather_index_bytes == 0
+        assert fused_cost.gather_bytes > 0       # real DMA traffic
+        split_cost = cost_of(registry.build_entry("train_step"))
+        assert split_cost.gather_index_bytes == 2080
+        findings = run_rules(specs[0], ("no_host_sync",))
+        assert [str(f) for f in findings] == []
 
     def test_every_census_lattice_point_is_traced(self):
         # the rules must walk EVERY reachable program, not one

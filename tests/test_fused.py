@@ -1,0 +1,325 @@
+"""Fused single-kernel sample+gather hop (``ops.pallas.fused``).
+
+Everything runs the kernel in interpret mode with the portable "hash"
+PRNG (the pltpu-native stream has no CPU interpret lowering on this
+jax), so the fused kernel and the split two-program oracle
+(``sample_layer_pallas`` + ``quant.gather_rows``) draw IDENTICAL
+streams and the equivalence pins are exact bit equality — picks AND
+dequantized rows, masked ``-1`` tails included.
+
+One tolerance caveat, pinned as such: the KERNEL outputs are bit-exact
+against the oracle, but a jnp graph that recomputes the int8 dequant in
+a different compilation context (the train step's backward pass
+rematerializes it) may round ``code*scale+zero`` through one fused
+multiply-add — a 1-ulp wobble that is XLA's, not the kernel's. Forward
+losses are bit-equal; int8 gradients are pinned to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops import quant
+from quiver_tpu.ops.pallas.fused import (fused_hot_hop,
+                                         fused_hot_hop_reference,
+                                         pad_indices)
+from quiver_tpu.ops.sample import compact_layer
+from quiver_tpu.parallel.train import (TrainState, build_train_step,
+                                       cross_entropy_logits, init_state,
+                                       layers_to_adjs,
+                                       masked_feature_gather)
+
+K = 4
+ROW_CAP = 64
+DIM = 128
+
+
+@pytest.fixture
+def graph(rng):
+    n = 300
+    deg = rng.integers(0, 12, n)
+    indptr = np.zeros(n + 1, np.int32)
+    indptr[1:] = np.cumsum(deg)
+    indices = rng.integers(0, n, indptr[-1]).astype(np.int32)
+    return jnp.asarray(indptr), jnp.asarray(indices), n
+
+
+def _both(indptr, indices, seeds, feat, seed, **kw):
+    idx = pad_indices(indices, ROW_CAP)
+    got = fused_hot_hop(indptr, idx, seeds, feat, K, seed,
+                        row_cap=ROW_CAP, rng="hash", interpret=True, **kw)
+    want = fused_hot_hop_reference(indptr, idx, seeds, feat, K, seed,
+                                   row_cap=ROW_CAP, rng="hash",
+                                   interpret=True, **kw)
+    return got, want
+
+
+def _assert_bitwise(got, want):
+    for g, w, name in zip(got, want, ("nbrs", "counts", "seed_rows",
+                                      "pick_rows")):
+        g, w = np.asarray(g), np.asarray(w)
+        assert g.dtype == w.dtype and g.shape == w.shape, name
+        assert g.tobytes() == w.tobytes(), \
+            f"{name} diverges from the split oracle"
+
+
+class TestFusedKernel:
+    def test_bitwise_int8(self, rng, graph):
+        indptr, indices, n = graph
+        feat = quant.quantize(jnp.asarray(
+            rng.standard_normal((n, DIM)).astype(np.float32)), "int8")
+        seeds = jnp.asarray(np.concatenate(
+            [rng.choice(n, 5, replace=False), [-1, -1, -1]]
+        ).astype(np.int32))
+        got, want = _both(indptr, indices, seeds, feat, jnp.int32(7))
+        _assert_bitwise(got, want)
+        # the masked tail's rows are exactly zero
+        assert not np.asarray(got[2])[5:].any()
+
+    def test_bitwise_plain_f32(self, rng, graph):
+        indptr, indices, n = graph
+        feat = jnp.asarray(
+            rng.standard_normal((n, DIM)).astype(np.float32))
+        seeds = jnp.asarray(
+            rng.choice(n, 8, replace=False).astype(np.int32))
+        _assert_bitwise(*_both(indptr, indices, seeds, feat,
+                               jnp.int32(3)))
+
+    def test_bitwise_forder_hot_rows(self, rng, graph):
+        # permuted storage + a hot-tier boundary: picks landing cold
+        # must come back as zero rows, identically in both programs
+        indptr, indices, n = graph
+        perm = rng.permutation(n).astype(np.int32)
+        forder = np.empty(n, np.int32)
+        forder[perm] = np.arange(n, dtype=np.int32)
+        feat = quant.quantize(jnp.asarray(
+            rng.standard_normal((n, DIM)).astype(np.float32)), "int8")
+        seeds = jnp.asarray(
+            rng.choice(n, 8, replace=False).astype(np.int32))
+        got, want = _both(indptr, indices, seeds, feat, jnp.int32(11),
+                          feature_order=jnp.asarray(forder),
+                          hot_rows=200)
+        _assert_bitwise(got, want)
+        # some pick actually fell cold, or the hot_rows path is vacuous
+        t = forder[np.clip(np.asarray(got[0]), 0, n - 1)]
+        assert ((np.asarray(got[0]) >= 0) & (t >= 200)).any()
+
+    def test_rows_match_masked_gather(self, rng, graph):
+        # the row outputs ARE masked_feature_gather of the picks — the
+        # train/serve reassembly contract
+        indptr, indices, n = graph
+        feat = quant.quantize(jnp.asarray(
+            rng.standard_normal((n, DIM)).astype(np.float32)), "int8")
+        seeds = jnp.asarray(np.array([5, -1, 17], np.int32))
+        (nbrs, _, seed_rows, pick_rows), _ = _both(
+            indptr, indices, seeds, feat, jnp.int32(2))
+        want_seed = masked_feature_gather(feat, seeds, None)
+        want_pick = masked_feature_gather(
+            feat, nbrs.reshape(-1).astype(jnp.int32), None)
+        np.testing.assert_array_equal(np.asarray(seed_rows),
+                                      np.asarray(want_seed))
+        np.testing.assert_array_equal(np.asarray(pick_rows),
+                                      np.asarray(want_pick))
+
+
+def _model_state(dim=DIM, bs=8, out=4):
+    model = GraphSAGE(hidden_dim=8, out_dim=out, num_layers=1,
+                      dropout=0.0)
+    empty = compact_layer(jnp.full((bs,), -1, jnp.int32),
+                          jnp.full((bs, K), -1, jnp.int32),
+                          seeds_dense=True)
+    adjs = layers_to_adjs([empty], bs, [K])
+    tx = optax.adam(1e-3)
+    state = init_state(model, tx, jnp.zeros((bs * (1 + K), dim)), adjs,
+                       jax.random.key(0))
+    return model, tx, state
+
+
+class TestFusedTrainStep:
+    def test_loss_bit_equal_and_updates(self, rng, graph):
+        indptr, indices, n = graph
+        bs = 8
+        model, tx, state = _model_state(bs=bs)
+        labels = jnp.asarray(rng.integers(0, 4, bs).astype(np.int32))
+        seeds = jnp.asarray(np.concatenate(
+            [rng.choice(n, 5, replace=False), [-1, -1, -1]]
+        ).astype(np.int32))
+        key = jax.random.key(42)
+        featf = jnp.asarray(
+            rng.standard_normal((n, DIM)).astype(np.float32))
+        featq = quant.quantize(featf, "int8")
+
+        step = build_train_step(model, tx, [K], bs, fused_hot_hop=True,
+                                fused_row_cap=ROW_CAP, donate=False)
+
+        def oracle(state, feat):
+            def loss_of(p):
+                info = jnp.iinfo(jnp.int32)
+                seedv = jax.random.randint(
+                    jax.random.fold_in(key, 0), (), info.min, info.max,
+                    jnp.int32)
+                nbrs, _, _, _ = fused_hot_hop_reference(
+                    indptr, pad_indices(indices, ROW_CAP), seeds, feat,
+                    K, seedv, row_cap=ROW_CAP, rng="hash",
+                    interpret=True)
+                layer = compact_layer(seeds, nbrs, seeds_dense=True)
+                x = masked_feature_gather(feat, layer.n_id, None)
+                adjs = layers_to_adjs([layer], bs, [K])
+                logits = model.apply(
+                    p, x, adjs, train=True,
+                    rngs={"dropout": jax.random.fold_in(key, 1000)})
+                return cross_entropy_logits(logits[:bs], labels)
+            loss, grads = jax.value_and_grad(loss_of)(state.params)
+            updates, opt = tx.update(grads, state.opt_state,
+                                     state.params)
+            return TrainState(optax.apply_updates(state.params,
+                                                  updates),
+                              opt, state.step + 1), loss
+
+        oracle = jax.jit(oracle)
+        for feat, exact_params in ((featf, True), (featq, False)):
+            st_f, loss_f = step(state, feat, None, indptr, indices,
+                                seeds, labels, key)
+            st_o, loss_o = oracle(state, feat)
+            assert np.asarray(loss_f).tobytes() == \
+                np.asarray(loss_o).tobytes()
+            pf = jax.tree_util.tree_leaves(st_f.params)
+            po = jax.tree_util.tree_leaves(st_o.params)
+            if exact_params:
+                for a, b in zip(pf, po):
+                    assert np.asarray(a).tobytes() == \
+                        np.asarray(b).tobytes()
+            else:
+                # int8 backward rematerializes the dequant; XLA may
+                # re-round it (module docstring) — 1-ulp tolerance
+                for a, b in zip(pf, po):
+                    np.testing.assert_allclose(np.asarray(a),
+                                               np.asarray(b),
+                                               atol=1e-6, rtol=1e-6)
+
+    def test_collect_metrics_frontier_counters(self, rng, graph):
+        from quiver_tpu.metrics import FRONTIER_CAP, FRONTIER_VALID
+        indptr, indices, n = graph
+        bs = 8
+        model, tx, state = _model_state(bs=bs)
+        labels = jnp.zeros((bs,), jnp.int32)
+        seeds = jnp.asarray(np.concatenate(
+            [rng.choice(n, 5, replace=False), [-1, -1, -1]]
+        ).astype(np.int32))
+        feat = quant.quantize(jnp.asarray(
+            rng.standard_normal((n, DIM)).astype(np.float32)), "int8")
+        plain = build_train_step(model, tx, [K], bs, fused_hot_hop=True,
+                                 fused_row_cap=ROW_CAP, donate=False)
+        metered = build_train_step(model, tx, [K], bs,
+                                   fused_hot_hop=True,
+                                   fused_row_cap=ROW_CAP, donate=False,
+                                   collect_metrics=True)
+        key = jax.random.key(1)
+        _, loss_p = plain(state, feat, None, indptr, indices, seeds,
+                          labels, key)
+        _, loss_m, counters = metered(state, feat, None, indptr,
+                                      indices, seeds, labels, key)
+        assert np.asarray(loss_p).tobytes() == \
+            np.asarray(loss_m).tobytes()
+        c = np.asarray(counters)
+        assert c[FRONTIER_CAP] == bs * (1 + K)
+        assert 0 < c[FRONTIER_VALID] <= c[FRONTIER_CAP]
+
+    def test_knob_validation(self):
+        model, tx, _ = _model_state()
+        with pytest.raises(ValueError, match="single hop"):
+            build_train_step(model, tx, [4, 4], 8, fused_hot_hop=True)
+        with pytest.raises(ValueError, match="exact"):
+            build_train_step(model, tx, [4], 8, fused_hot_hop=True,
+                             method="rotation")
+        with pytest.raises(ValueError, match="dedup_gather"):
+            build_train_step(model, tx, [4], 8, fused_hot_hop=True,
+                             dedup_gather=True)
+
+
+class TestFusedServeStep:
+    def test_plain_store_matches_oracle(self, rng, graph):
+        from quiver_tpu.serving import build_serve_step
+        indptr, indices, n = graph
+        cap = 8
+        model, _, state = _model_state(bs=cap)
+        feat = quant.quantize(jnp.asarray(
+            rng.standard_normal((n, DIM)).astype(np.float32)), "int8")
+        step = build_serve_step(model, [K], cap, fused_hot_hop=True,
+                                fused_row_cap=ROW_CAP)
+        seeds = np.full((cap,), -1, np.int32)
+        seeds[:3] = [3, 7, 11]
+        key = jax.random.key(5)
+        _, logits = step(state.params, key, feat, None, indptr,
+                         indices, jnp.asarray(seeds))
+
+        def oracle(params, key, feat, seeds):
+            key, sub = jax.random.split(key)
+            info = jnp.iinfo(jnp.int32)
+            seedv = jax.random.randint(jax.random.fold_in(sub, 0), (),
+                                       info.min, info.max, jnp.int32)
+            nbrs, _, _, _ = fused_hot_hop_reference(
+                indptr, pad_indices(indices, ROW_CAP), seeds, feat, K,
+                seedv, row_cap=ROW_CAP, rng="hash", interpret=True)
+            layer = compact_layer(seeds, nbrs, seeds_dense=True)
+            x = masked_feature_gather(feat, layer.n_id, None)
+            adjs = layers_to_adjs([layer], cap, [K])
+            return model.apply(params, x, adjs, train=False)[:cap]
+
+        want = jax.jit(oracle)(state.params, jax.random.key(5), feat,
+                               jnp.asarray(seeds))
+        np.testing.assert_allclose(np.asarray(logits)[:3],
+                                   np.asarray(want)[:3],
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_tiered_feature_cold_fixup(self, rng, graph):
+        # ServeEngine over a hot+cold Feature store: hot rows from the
+        # kernel, cold picks through the store's unchanged tiered
+        # lookup — logits match a step that runs the WHOLE frontier
+        # through the tiered lookup
+        from quiver_tpu.feature import Feature
+        from quiver_tpu.serving import ServeEngine, _feature_gather
+        from quiver_tpu.utils import CSRTopo
+        indptr, indices, n = graph
+        cap = 8
+        model, _, state = _model_state(bs=cap)
+        feat = rng.standard_normal((n, DIM)).astype(np.float32)
+        topo = CSRTopo(indptr=indptr, indices=indices)
+        store = Feature(rank=0, device_cache_size=120 * (DIM + 8),
+                        cache_policy="device_replicate", csr_topo=topo,
+                        dtype_policy="int8")
+        store.from_cpu_tensor(feat)
+        assert 0 < store.cache_rows < n     # genuinely tiered
+        eng = ServeEngine(model, state.params, topo, store, [[K]], cap,
+                          fused_hot_hop=True, fused_row_cap=ROW_CAP)
+        seeds = np.full((cap,), -1, np.int32)
+        seeds[:3] = [3, 7, 11]
+        _, logits = eng._steps[0](state.params, jax.random.key(0),
+                                  eng._feat, eng._forder, eng._indptr,
+                                  eng._indices, jnp.asarray(seeds))
+        _, _, store_gather = _feature_gather(store)
+        hot = eng._feat[0]
+
+        def oracle(params, key, feat_args, forder, seeds):
+            key, sub = jax.random.split(key)
+            info = jnp.iinfo(jnp.int32)
+            seedv = jax.random.randint(jax.random.fold_in(sub, 0), (),
+                                       info.min, info.max, jnp.int32)
+            nbrs, _, _, _ = fused_hot_hop_reference(
+                indptr, pad_indices(indices, ROW_CAP), seeds, hot, K,
+                seedv, row_cap=ROW_CAP, rng="hash", interpret=True,
+                feature_order=forder, hot_rows=store.cache_rows)
+            layer = compact_layer(seeds, nbrs, seeds_dense=True)
+            x = store_gather(feat_args, layer.n_id, forder)
+            adjs = layers_to_adjs([layer], cap, [K])
+            return model.apply(params, x, adjs, train=False)[:cap]
+
+        want = jax.jit(oracle)(state.params, jax.random.key(0),
+                               eng._feat, eng._forder,
+                               jnp.asarray(seeds))
+        np.testing.assert_allclose(np.asarray(logits)[:3],
+                                   np.asarray(want)[:3],
+                                   atol=1e-6, rtol=1e-6)
