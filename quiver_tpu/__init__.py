@@ -48,6 +48,7 @@ from .io import ExtentReader, StorageModel, plan_extents
 from .debug import show_tensor_info
 from .inference import layerwise_inference
 from .datasets import (GraphDataset, from_numpy_dir,
+                       generate_drifting_trace,
                        generate_synthetic_cold_dataset,
                        load_synthetic_cold_dataset)
 from .pipeline import Pipeline, pipelined
@@ -59,12 +60,13 @@ from .telemetry import FlightRecorder, PlanContext, TelemetryHub
 from .profile import StageProfiler, machine_probe
 from .fleet import (FleetAggregator, FleetExporter, HealthRouter,
                     ReplicaSupervisor, health_score)
+from .actuator import Actuator, FleetAutoscaler, Knob
 from .faults import FaultPlan, FaultRule
 from .rpc import (RpcClient, RpcError, RpcServer, DeadlineExceeded,
                   ServerClosed)
-from . import (analysis, comm, profiling, checkpoint, datasets, debug,
-               faults, fleet, metrics, profile, rpc, serving,
-               tailsampling, telemetry, tracing)
+from . import (actuator, analysis, comm, profiling, checkpoint,
+               datasets, debug, faults, fleet, metrics, profile, rpc,
+               serving, tailsampling, telemetry, tracing)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -74,6 +76,7 @@ getNcclId = get_comm_id
 __all__ = [
     "GraphDataset",
     "from_numpy_dir",
+    "generate_drifting_trace",
     "generate_synthetic_cold_dataset",
     "load_synthetic_cold_dataset",
     "CSRTopo",
@@ -144,6 +147,9 @@ __all__ = [
     "HealthRouter",
     "ReplicaSupervisor",
     "health_score",
+    "Actuator",
+    "FleetAutoscaler",
+    "Knob",
     "FaultPlan",
     "FaultRule",
     "RpcClient",

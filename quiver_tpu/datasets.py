@@ -30,6 +30,7 @@ into ``CSRTopo`` + ``Feature`` + the train loops
 from __future__ import annotations
 
 import json
+import math
 import os
 from typing import NamedTuple, Optional
 
@@ -341,3 +342,59 @@ def load_synthetic_cold_dataset(out_dir: str,
                                    decode_staged=decode_staged,
                                    **prefetch_kwargs)
     return topo, store, meta
+
+
+def generate_drifting_trace(length: int, nodes: int,
+                            skew: float = 2.0,
+                            rotate_every: int = 1 << 14,
+                            stride: Optional[int] = None,
+                            hot_frac: float = 0.05,
+                            seed: int = 0, lo: int = 0,
+                            hi: Optional[int] = None) -> np.ndarray:
+    """A seeded node-id trace whose power-law HOT SET rotates on a
+    schedule — the adversarial input adaptive caching (the qt-act
+    actuator's hot-set rotation) must win on and static placement
+    must lose on.
+
+    Each position draws a popularity RANK ``floor(nodes * u**skew)``
+    (density concentrated on low ranks — the
+    :func:`generate_synthetic_cold_dataset` neighbor idiom), then the
+    rank maps to a node id shifted by the position's drift phase::
+
+        phase = index // rotate_every
+        id    = (rank + phase * stride) % nodes
+
+    so inside one phase the trace is a stationary power-law over a
+    contiguous hot set, and every ``rotate_every`` positions the
+    WHOLE popularity ordering shifts by ``stride`` ids (default: the
+    hot-set width, ``ceil(nodes * hot_frac)`` — each drift lands the
+    new hot set entirely outside the old one). The first phase
+    (indices ``[0, rotate_every)``) is the STATIONARY PREFIX the A/B
+    protocol scores "no worse than static" on.
+
+    Chunk-invariant like the cold generator: ranks come from fixed
+    ``_GEN_BLOCK``-sized blocks keyed ``(seed, block_start)`` and the
+    phase depends only on the ABSOLUTE index, so any ``[lo, hi)``
+    slicing assembles the identical trace (pinned in
+    tests/test_actuator.py). Returns int64 ids in ``[0, nodes)``."""
+    if length < 0:
+        raise ValueError(f"length must be >= 0, got {length}")
+    if nodes < 1:
+        raise ValueError(f"nodes must be >= 1, got {nodes}")
+    if rotate_every < 1:
+        raise ValueError(
+            f"rotate_every must be >= 1, got {rotate_every}")
+    if stride is None:
+        stride = max(1, int(math.ceil(nodes * float(hot_frac))))
+    hi = length if hi is None else hi
+    if not 0 <= lo <= hi <= length:
+        raise ValueError(f"need 0 <= lo <= hi <= length, got "
+                         f"[{lo}, {hi}) of {length}")
+    if hi == lo:
+        return np.empty((0,), np.int64)
+    ranks = _gen_block(
+        seed, lo, hi, length, (),
+        lambda r, k: np.minimum((nodes * r.random(k) ** skew),
+                                nodes - 1).astype(np.int64))
+    phase = np.arange(lo, hi, dtype=np.int64) // int(rotate_every)
+    return (ranks + phase * int(stride)) % int(nodes)

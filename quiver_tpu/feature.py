@@ -819,6 +819,111 @@ class Feature:
                                      self.disk_map)))
         return self._disk_map_np[1]
 
+    # -- online hot-set rotation (qt-act) -----------------------------------
+    def rotate_hot_set(self, promote, demote):
+        """Swap ``demote`` (hot nodes) out of the HBM tier for
+        ``promote`` (cold nodes) — FastSample-style locality-aware
+        cache adaptation (arXiv 2311.17847) through the hot-order
+        permutation machinery, ONLINE: stored row bytes (codes AND
+        sidecars for a quantized tier) move between tiers verbatim and
+        ``feature_order`` swaps the two nodes' storage rows, so every
+        lookup is bit-identical across the rotation and no jitted
+        program recompiles (``_lookup_tiered`` takes the tiers and the
+        order as ARGUMENTS; the swapped arrays keep their shapes and
+        dtypes, so the executable cache stays flat —
+        ``scripts/check_leak.py`` phase 13 pins both).
+
+        Requirements (refused loudly otherwise — a refused rotation
+        must never half-move rows): a built store with
+        ``feature_order``, a non-empty HBM tier AND a numpy host tier
+        (disk/mmap stores adapt through ``stage_frontier`` ring
+        promotion instead; ``host_placement="offload"`` pins the cold
+        tier immutably), a replicated hot tier (a row-sharded tier
+        would need a cross-device scatter), and IDENTICAL hot/cold
+        dtype policies (mixed policies re-encode on crossing, which
+        breaks bit-identity).
+
+        A ``ServeEngine`` built over this store captured the tier
+        arrays at construction — call ``engine.refresh_feature()``
+        after rotating. Returns ``{"rotated": k}``."""
+        if self.feature_order is None:
+            raise ValueError(
+                "rotate_hot_set needs a hot-order store (feature_order "
+                "is None — construct with a csr_topo or set_local_order)")
+        if not self.cache_rows or self.device_part is None:
+            raise ValueError("rotate_hot_set needs a non-empty HBM tier")
+        if self.host_part is None:
+            raise ValueError(
+                "rotate_hot_set needs a numpy host tier (disk/mmap "
+                "stores promote through stage_frontier; offloaded cold "
+                "tiers are pinned immutably)")
+        if self.cache_policy != "device_replicate" and self._mesh_size() > 1:
+            raise ValueError(
+                "rotate_hot_set supports replicated hot tiers only "
+                "(a row-sharded tier would need a cross-device scatter)")
+        if self.dtype_policy["hot"] != self.dtype_policy["cold"]:
+            raise ValueError(
+                f"rotate_hot_set needs identical hot/cold dtype "
+                f"policies (got {self.dtype_policy!r}); rows crossing "
+                "tiers would re-encode and break bit-identity")
+        promote = np.unique(np.asarray(promote, np.int64).reshape(-1))
+        demote = np.unique(np.asarray(demote, np.int64).reshape(-1))
+        if promote.size != demote.size:
+            raise ValueError(
+                f"promote/demote must pair 1:1, got {promote.size} vs "
+                f"{demote.size} unique ids")
+        if promote.size == 0:
+            return {"rotated": 0}
+        order = np.array(self._order_host(), copy=True)
+        n = order.shape[0]
+        for ids, what in ((promote, "promote"), (demote, "demote")):
+            if ids[0] < 0 or ids[-1] >= n:
+                raise ValueError(f"{what} ids out of range [0, {n})")
+        rp = order[promote]            # storage rows, must be cold
+        rd = order[demote]             # storage rows, must be hot
+        if not (rp >= self.cache_rows).all():
+            raise ValueError("promote ids must currently be cold rows")
+        if not (rd < self.cache_rows).all():
+            raise ValueError("demote ids must currently be hot rows")
+        host_rows = rp - self.cache_rows
+        dev_leaves = quant.tier_parts(self.device_part)
+        host_leaves = quant.tier_parts(self.host_part)
+        # pad the row sets to a power-of-two bucket: the device gather
+        # and scatter below compile once PER SHAPE, and a census-driven
+        # rotation produces a different pair count almost every time —
+        # unbucketed, each rotation pays a fresh ~200ms compile (a
+        # compile storm on the adaptation cadence) and grows the
+        # executable set without bound. Padding repeats pair 0, so the
+        # duplicate scatter writes are byte-identical to the real one.
+        k = int(rd.size)
+        pad = (1 << max(3, (k - 1).bit_length())) - k
+        rd_pad = np.concatenate([rd, np.full(pad, rd[0], rd.dtype)])
+        new_dev = []
+        for dl, hl in zip(dev_leaves, host_leaves):
+            if dl is None:
+                new_dev.append(None)
+                continue
+            # the demoted hot rows come down once (host sync is fine:
+            # rotation is a rare control action, never on the hot path)
+            down = np.asarray(jax.device_get(dl[rd_pad]))[:k]
+            up = np.asarray(hl[host_rows])
+            up_pad = np.concatenate([up, np.repeat(up[:1], pad,
+                                                   axis=0)])
+            # functional device update -> a NEW array of the same
+            # shape/dtype (no recompile); numpy host update in place
+            new_dev.append(jnp.asarray(dl).at[rd_pad].set(up_pad))
+            hl[host_rows] = down
+        if quant.is_quantized(self.device_part):
+            self.device_part = quant.QuantizedTensor(*new_dev)
+        else:
+            self.device_part = new_dev[0]
+        order[promote] = rd
+        order[demote] = rp
+        # a NEW order array: the identity-keyed _order_host cache
+        # invalidates itself, and jitted programs see a same-shape arg
+        self.feature_order = jnp.asarray(order, dtype=jnp.int32)
+        return {"rotated": int(promote.size)}
+
     # -- cold-tier (disk) prefetch ------------------------------------------
     def enable_cold_prefetch(self, capacity_rows: int = 65_536,
                              depth: int = 2, decode_staged: bool = True,

@@ -63,6 +63,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import math
 import random
 import threading
 import time
@@ -475,6 +476,14 @@ class HealthRouter:
             self._drained.discard(str(name))
             self.readmits += 1
 
+    def forget(self, name: str) -> None:
+        """Remove a replica entirely (a scale-down retired it) — a
+        drained ghost would otherwise linger in :meth:`ranked`'s
+        last-resort tail forever."""
+        with self._lock:
+            self._scores.pop(str(name), None)
+            self._drained.discard(str(name))
+
     def _active(self, exclude) -> Tuple[List[str], List[str]]:
         ex = set(exclude)
         active = [n for n in self._scores
@@ -524,6 +533,63 @@ class HealthRouter:
                     "drained": sorted(self._drained),
                     "picks": self.picks, "drains": self.drains,
                     "readmits": self.readmits}
+
+    @staticmethod
+    def plan_quality(snapshot: dict, ladder: int,
+                     step_burn: float = 0.5) -> dict:
+        """Turn a :class:`FleetAggregator` snapshot into one PLANNED
+        fleet-wide quality floor (the qt-act fleet actuation: today
+        each replica sheds alone, reacting only to its own queue/burn;
+        this makes the latency/quality trade a fleet decision). The
+        policy is deterministic and arguable from its inputs:
+
+        - only non-stale replicas vote (a silent replica's last burn
+          is stale data, and staleness is the supervisor's problem,
+          not a quality problem); with NO live replica the floor is 0
+          — shedding quality cannot help a fleet that is down;
+        - the fleet burn is the MEAN of the voters' worst burn rates
+          (one hot replica should shift traffic — the router's job —
+          not degrade everyone; the whole fleet burning is what
+          justifies a fleet-wide floor);
+        - every ``step_burn`` of mean burn past sustainable (1.0)
+          plans one shed step, capped at ``ladder`` (the variant
+          ladder depth, ``len(engine.variants) - 1``).
+
+        Returns ``{"shed_floor", "burn_mean", "burn_max",
+        "considered", "stale_count", "ladder"}`` — the payload an
+        ``actuate`` record carries so the plan self-explains. The
+        :class:`~quiver_tpu.actuator.Actuator` applies the floor via
+        ``MicroBatchServer.set_shed_floor`` under its cooldown, so an
+        oscillating burn cannot flap the fleet."""
+        ladder = max(int(ladder), 0)
+        reps = (snapshot.get("replicas") or {})
+        burns = []
+        stale = 0
+        for rec in reps.values():
+            comp = rec.get("components") or {}
+            if rec.get("stale") or comp.get("stale"):
+                stale += 1
+                continue
+            b = comp.get("burn")
+            if b is not None:
+                burns.append(float(b))
+        if burns:
+            burn_mean = sum(burns) / len(burns)
+            burn_max = max(burns)
+            excess = max(0.0, burn_mean - 1.0)
+            floor = min(ladder, int(math.ceil(excess / step_burn
+                                              - 1e-9)) if excess > 0
+                        else 0)
+        else:
+            burn_mean = burn_max = None
+            floor = 0
+        return {"shed_floor": floor,
+                "burn_mean": (None if burn_mean is None
+                              else round(burn_mean, 4)),
+                "burn_max": (None if burn_max is None
+                             else round(burn_max, 4)),
+                "considered": len(burns), "stale_count": stale,
+                "ladder": ladder}
 
 
 # -- replica supervision -------------------------------------------------------
@@ -724,6 +790,131 @@ class ReplicaSupervisor:
         events.append(dict(
             event, replica=c.name, consecutive=c.consecutive,
             restart_in_s=round(backoff, 3)))
+
+    # -- elastic scaling (qt-act) ---------------------------------------------
+    def _fresh_names(self, n: int) -> List[str]:
+        taken = set(self.names)
+        out: List[str] = []
+        i = len(self.names)
+        while len(out) < n:
+            cand = f"r{i}"
+            i += 1
+            if cand not in taken:
+                taken.add(cand)
+                out.append(cand)
+        return out
+
+    def grow(self, n: int = 1,
+             names: Optional[Sequence[str]] = None) -> List[str]:
+        """Add ``n`` replicas (or the explicitly ``names``d ones) to
+        the supervised set — each spawns on the next monitor tick
+        through the SAME spawn/backoff/breaker path a restart takes,
+        so a replica that dies on arrival pays the ladder, not a
+        hot-loop. Emits one ``scale_up`` chaos event. Returns the new
+        names."""
+        new = ([str(x) for x in names] if names
+               else self._fresh_names(int(n)))
+        if not new:
+            return []
+        with self._lock:
+            dup = [x for x in new if x in self._children]
+            if dup:
+                raise ValueError(f"replica names already exist: {dup}")
+            for name in new:
+                self.names.append(name)
+                self._children[name] = _Child(name)
+        self._event(event="scale_up", replicas=list(new),
+                    count=len(self.names))
+        return new
+
+    def shrink(self, n: int = 1,
+               names: Optional[Sequence[str]] = None,
+               drain: Optional[Callable[[str], None]] = None,
+               drain_wait_s: float = 0.0) -> List[str]:
+        """Retire ``n`` replicas (newest first, or the explicitly
+        ``names``d ones) WITHOUT losing a request — the zero-loss
+        choreography the PR 14 chaos gate extension pins:
+
+        1. ``drain(name)`` (typically ``HealthRouter.drain``) stops
+           NEW traffic routing at each victim;
+        2. ``drain_wait_s`` lets in-flight requests finish (the RPC
+           client's retry/hedge path re-routes any that don't);
+        3. only THEN the victim leaves the supervised set (so the
+           monitor won't resurrect it) and gets SIGTERM, escalating
+           to SIGKILL after ``grace_s`` — the replica's own graceful
+           close resolves everything it already claimed.
+
+        A retirement is NOT a crash: no backoff, no breaker, one
+        ``scale_down`` chaos event. At least one replica always
+        remains. Returns the retired names."""
+        with self._lock:
+            pool = list(self.names)
+        if names:
+            victims = [str(x) for x in names]
+            missing = [x for x in victims if x not in pool]
+            if missing:
+                raise ValueError(f"unknown replicas: {missing}")
+        else:
+            victims = pool[-int(n):] if int(n) > 0 else []
+        if not victims:
+            return []
+        if len(victims) >= len(pool):
+            raise ValueError(
+                f"shrink would retire every replica ({victims}); "
+                "at least one must remain")
+        if drain is not None:
+            for name in victims:
+                drain(name)
+        if drain_wait_s > 0:
+            time.sleep(float(drain_wait_s))
+        procs = []
+        with self._lock:
+            for name in victims:
+                c = self._children.pop(name)
+                self.names.remove(name)
+                if c.proc is not None and c.proc.poll() is None:
+                    procs.append(c.proc)
+        # signal OUTSIDE the lock (the monitor must keep stepping the
+        # survivors while a slow victim drains out)
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.grace_s
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.0))
+            except Exception:
+                try:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                except Exception:
+                    pass
+        self._event(event="scale_down", replicas=list(victims),
+                    count=len(self.names), drained=drain is not None)
+        return victims
+
+    def scale_to(self, count: int, drain=None,
+                 drain_wait_s: float = 0.0) -> List[str]:
+        """Grow or shrink to exactly ``count`` replicas; returns the
+        names added or retired (empty list when already at size)."""
+        count = int(count)
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        with self._lock:
+            cur = len(self.names)
+        if count > cur:
+            return self.grow(count - cur)
+        if count < cur:
+            return self.shrink(cur - count, drain=drain,
+                               drain_wait_s=drain_wait_s)
+        return []
+
+    @property
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self.names)
 
     # -- chaos + introspection ------------------------------------------------
     def kill(self, name: str, sig=None) -> Optional[int]:

@@ -218,9 +218,17 @@ def take_np(t, ids):
     numpy until the scatter onto the device result)."""
     if not is_quantized(t):
         return t[ids]
-    # decode in scale.dtype — the store's logical dtype — matching
-    # gather_rows/dequantize
-    return t.data[ids].astype(t.scale.dtype) * t.scale[ids] + t.zero[ids]
+    # decode through float64 then round once to the logical dtype:
+    # numerically this IS the fused multiply-add (the f64 product of
+    # two f32/bf16 values is exact and the double rounding is
+    # innocuous at >= 2p+2 spare bits), so the numpy path rounds
+    # identically to the jitted XLA decode and the Pallas kernel's
+    # in-register FMA — which is what lets an online hot-set rotation
+    # move a row between decode engines bit-identically
+    out = (t.data[ids].astype(np.float64)
+           * np.asarray(t.scale[ids], np.float64)
+           + np.asarray(t.zero[ids], np.float64))
+    return out.astype(t.scale.dtype)
 
 
 def tree_map_tier(fn, t):

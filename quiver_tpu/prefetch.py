@@ -481,7 +481,12 @@ class ColdPrefetcher:
             scale = np.asarray(f.disk_scale[new])
             zero = np.asarray(f.disk_zero[new])
             if self.decode_staged:
-                rows = rows.astype(scale.dtype) * scale + zero
+                # f64-then-round = the FMA rounding (quant.take_np):
+                # every numpy decode site must agree bit-for-bit
+                rows = (rows.astype(np.float64)
+                        * np.asarray(scale, np.float64)
+                        + np.asarray(zero, np.float64)
+                        ).astype(scale.dtype)
                 scale = zero = None
         elif self.decode_staged and rows.dtype != self._ring.rows.dtype:
             rows = rows.astype(self._ring.rows.dtype)
@@ -499,7 +504,10 @@ class ColdPrefetcher:
         else:
             hit, rows, scale, zero = self._ring.take(ids)
             if self._quantized and rows.size:
-                rows = rows.astype(scale.dtype) * scale + zero
+                rows = (rows.astype(np.float64)
+                        * np.asarray(scale, np.float64)
+                        + np.asarray(zero, np.float64)
+                        ).astype(scale.dtype)
             out[hit] = rows
         return hit
 

@@ -295,7 +295,9 @@ class ServeEngine:
         self._indptr = jnp.asarray(indptr, jnp.int32)
         self._indices = jnp.asarray(indices, jnp.int32)
         gather = None
+        self._store = None
         if hasattr(feat, "lookup_tiered"):        # a Feature store
+            self._store = feat
             feat, forder, gather = _feature_gather(feat)
         elif isinstance(feat, np.ndarray):
             feat = jnp.asarray(feat)
@@ -366,6 +368,36 @@ class ServeEngine:
         for v in range(len(self.variants)):
             jax.block_until_ready(self.run(
                 np.zeros((self.batch_cap,), np.int32), v))
+        return self
+
+    def refresh_feature(self) -> "ServeEngine":
+        """Re-splice the underlying ``Feature`` store's tier arrays
+        into this engine after an online mutation
+        (``Feature.rotate_hot_set``): the engine captured
+        ``device_part``/``host_part``/``feature_order`` at
+        construction, so a rotation the store applied would otherwise
+        serve from the STALE pre-rotation arrays. The gather closure
+        itself stays valid (it reads the tiers from program arguments),
+        and the refreshed arrays must keep their shapes and dtypes —
+        verified here, so a refresh can never recompile (the
+        executable-cache flatness ``check_leak`` phase 13 pins)."""
+        if self._store is None:
+            raise ValueError(
+                "refresh_feature needs an engine built over a Feature "
+                "store (this one was built over a plain array)")
+        feat, forder, _ = _feature_gather(self._store)
+
+        def sig(t):
+            return [(tuple(l.shape), str(l.dtype))
+                    for l in jax.tree_util.tree_leaves(t)]
+
+        if sig(feat) != sig(self._feat):
+            raise ValueError(
+                "refreshed feature tiers changed shape or dtype — "
+                "refusing (the serve programs would recompile)")
+        self._feat = feat
+        self._forder = None if forder is None else \
+            jnp.asarray(forder, jnp.int32)
         return self
 
 
@@ -561,6 +593,14 @@ class MicroBatchServer:
         # shedding state (coalescer-thread only, except the counters)
         self._shed_level = 0
         self._calm = 0
+        # actuation surfaces (quiver_tpu.actuator): the EFFECTIVE
+        # coalescing knobs, re-read by the coalescer per batch so a
+        # swap lands on the next batch without a restart. The seed
+        # shape stays [engine.batch_cap] whatever the fill cap, so no
+        # knob swap can ever compile a new program.
+        self._max_wait_s = cfg.max_wait_ms / 1e3
+        self._fill_cap = engine.batch_cap
+        self._shed_floor = 0
         self._counts = {
             "requests": 0, "rejected": 0, "completed": 0, "failed": 0,
             "deadline_expired": 0,
@@ -712,6 +752,55 @@ class MicroBatchServer:
                 raise
         return futs
 
+    # -- actuation surfaces (qt-act) ----------------------------------------
+    def set_max_wait_ms(self, ms: float) -> None:
+        """Swap the effective coalescing deadline (the ``max_wait_ms``
+        knob the hub's advisor sizes). Takes effect on the NEXT batch;
+        no program input changes, so nothing recompiles."""
+        ms = float(ms)
+        if not ms > 0.0:
+            raise ValueError(f"max_wait_ms must be > 0, got {ms}")
+        self._max_wait_s = ms / 1e3
+
+    def set_batch_fill_cap(self, cap: Optional[int]) -> None:
+        """Swap the effective coalescing FILL cap (the ``batch_cap``
+        knob's safe actuation form): batches stop coalescing at ``cap``
+        distinct seeds but still dispatch at the engine's compiled
+        ``[batch_cap]`` seed shape (-1 padded), so every value in
+        ``[1, engine.batch_cap]`` reuses the census'd executables
+        verbatim. ``None`` restores the engine cap. Growing past the
+        compiled shape is impossible by construction — the actuator
+        refuses such advice instead of recompiling."""
+        if cap is None:
+            self._fill_cap = self.engine.batch_cap
+            return
+        cap = int(cap)
+        if not 1 <= cap <= self.engine.batch_cap:
+            raise ValueError(
+                f"batch fill cap must be in [1, "
+                f"{self.engine.batch_cap}], got {cap}")
+        self._fill_cap = cap
+
+    def set_shed_floor(self, level: int) -> None:
+        """Planned fleet-wide quality floor
+        (``fleet.HealthRouter.plan_quality``): dispatches never run a
+        variant ABOVE quality ``level`` while the floor is raised — the
+        local hysteresis still escalates further under local pressure.
+        0 restores full local autonomy."""
+        level = int(level)
+        top = len(self.engine.variants) - 1
+        if not 0 <= level <= top:
+            raise ValueError(
+                f"shed floor must be in [0, {top}], got {level}")
+        self._shed_floor = level
+
+    def knobs(self) -> dict:
+        """The effective actuation knobs (the ``before``/``after``
+        readbacks the ``actuate`` JSONL records carry)."""
+        return {"max_wait_ms": round(self._max_wait_s * 1e3, 6),
+                "batch_fill_cap": self._fill_cap,
+                "shed_floor": self._shed_floor}
+
     # -- coalescing ---------------------------------------------------------
     def _coalesce_guard(self):
         """The coalescer's thread-death watchdog: any exception
@@ -765,10 +854,13 @@ class MicroBatchServer:
         return True
 
     def _coalesce_loop(self):
-        max_wait = self.config.max_wait_ms / 1e3
-        cap = self.engine.batch_cap
         while not self._closed:
             faults.fire("serve.coalesce")
+            # effective knobs re-read per batch: the actuator may swap
+            # them mid-traffic (set_max_wait_ms / set_batch_fill_cap),
+            # and a swap must land on the NEXT batch without a restart
+            max_wait = self._max_wait_s
+            cap = min(self._fill_cap, self.engine.batch_cap)
             try:
                 first = self._q.get(timeout=0.02)
             except queue.Empty:
@@ -813,7 +905,10 @@ class MicroBatchServer:
                     tracing.record("serve.admission_wait", req.t_enq,
                                    t_pop - req.t_enq, req.trace_id,
                                    {"batch": bid, "node": req.node_id})
-            seeds = np.full((cap,), -1, np.int32)
+            # the seed block keeps the engine's COMPILED width whatever
+            # the fill cap — a fill-cap swap changes padding, never the
+            # program shape
+            seeds = np.full((self.engine.batch_cap,), -1, np.int32)
             for nid, s in slots.items():
                 seeds[s] = nid
             variant = self._select_variant()
@@ -858,7 +953,9 @@ class MicroBatchServer:
         hysteresis, unchanged, so the variant mix doesn't flap (each
         flap costs nothing in compiles — every variant is pre-compiled
         — but a stable mix keeps the reported accuracy tradeoff
-        meaningful)."""
+        meaningful). A planned fleet-wide floor (``set_shed_floor``,
+        fed by ``fleet.HealthRouter.plan_quality``) lower-bounds the
+        decision without disturbing the local hysteresis state."""
         top = len(self.engine.variants) - 1
         if top == 0:
             return 0
@@ -875,7 +972,7 @@ class MicroBatchServer:
             if self._calm >= cfg.calm_batches:
                 self._shed_level -= 1
                 self._calm = 0
-        return self._shed_level
+        return max(self._shed_level, min(self._shed_floor, top))
 
     # -- execution + scatter ------------------------------------------------
     def _fail_batch(self, batch, msg: str = "server closed before "
@@ -1040,6 +1137,7 @@ class MicroBatchServer:
             "shed_level": self._shed_level,
             "fanout_variants": [list(v) for v in self.engine.variants],
             "health": self.health()["score"],
+            "knobs": self.knobs(),
         }
         return rec
 

@@ -103,6 +103,18 @@ it a measured fact: zero executable growth, zero recompiles through
 the server's own watch, flat live arrays, the tracer ring within its
 capacity, and the pending high-water never past the configured bound.
 
+Phase 13 pins ACTUATION (qt-act): 50 metered int8-tier lookups
+spanning three actuated serving-knob swaps (batch fill cap + coalesce
+deadline, driven through the Actuator by synthetic advice) and two
+online hot-set rotations, each step bit-compared against an UNACTUATED
+control store replaying the identical id sequence. The census-first
+contract becomes a measured fact: zero executable growth (a swap lands
+on an already-counted lattice point; a rotation is a same-shape
+functional update), zero recompiles through the engine's watch, rows
+bit-identical to the control (for the quantized tiers that is the FMA
+decode convention doing its job as rows cross tiers), and live arrays
+flat.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -1020,6 +1032,107 @@ def main():
     tail_sink.close()
     print("no leak detected (phase 12: always-on tail sampling with "
           "the pending table under eviction pressure)")
+
+    # ---- phase 13: advice-driven actuation — swaps + rotations, flat ----
+    # The qt-act safety contract, measured: an actuated store/server
+    # must behave EXACTLY like an unactuated one except for placement.
+    # Store A takes three knob swaps (through the Actuator, synthetic
+    # advice, fake clock) and two hot-set rotations mid-loop; store B
+    # replays the identical 50-step id sequence untouched. Both are
+    # int8-tiered, so the bit-compare also pins the FMA decode
+    # convention as rotated rows change decode engines (numpy cold
+    # tier <-> jitted hot tier).
+    from quiver_tpu.actuator import Actuator
+
+    itopoA = qv.CSRTopo(indptr=indptr, indices=indices)
+    itopoB = qv.CSRTopo(indptr=indptr, indices=indices)
+    act_store = qv.Feature(device_cache_size=n // 4 * dim,
+                           csr_topo=itopoA, dtype_policy="int8")
+    act_store.from_cpu_tensor(feat)
+    ctl_store = qv.Feature(device_cache_size=n // 4 * dim,
+                           csr_topo=itopoB, dtype_policy="int8")
+    ctl_store.from_cpu_tensor(feat)
+    aserver = MicroBatchServer(engine, ServeConfig(
+        max_wait_ms=1.0, queue_depth=256, shed_queue_frac=0.5))
+    clk = [0.0]
+    act = Actuator(clock=lambda: clk[0], cooldown_s=1.0, settle_s=0.0)
+    act.attach_server(aserver)
+    id_seq = [rng.integers(0, n, 512).astype(np.int32)
+              for _ in range(50)]
+    # synthetic advice: three swaps across the pre-census'd lattices
+    # (fill caps are powers of two under the compiled 64; deadlines on
+    # the default lattice), plus one out-of-lattice point that MUST be
+    # refused without touching anything
+    swap_plan = {10: {"key": "batch_cap", "recommended": 32},
+                 20: {"key": "max_wait_ms", "recommended": 0.5},
+                 25: {"key": "batch_cap", "recommended": 48},  # refuse
+                 30: {"key": "batch_cap", "recommended": 64}}
+
+    # settle both lookup paths and the server, then baseline
+    for s in (act_store, ctl_store):
+        jax.block_until_ready(s.lookup_tiered(
+            jnp.asarray(id_seq[0]), collect_metrics=True)[0])
+    for f in [aserver.submit(int(i)) for i in rng.integers(0, n, 20)]:
+        f.result(timeout=60)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = (sum(f._cache_size() for f in engine.jitted_fns)
+                  + act_store._lookup_tiered._cache_size())
+
+    rotations = 0
+    for i, ids in enumerate(id_seq):
+        clk[0] = float(i)
+        if i in swap_plan:
+            rec = dict(swap_plan[i], observed={}, reason="phase 13")
+            act.tick([rec])
+            # the swapped knobs carry real traffic before the next swap
+            for f in [aserver.submit(int(v)) for v in ids[:8]]:
+                assert np.isfinite(f.result(timeout=60)).all()
+        if i in (15, 35):
+            order = act_store._order_host()
+            cold = np.nonzero(
+                order >= act_store.cache_rows)[0][:64]
+            act.observe_ids(np.tile(cold, 3), total_rows=n)
+            rrec = act.maybe_rotate(act_store, max_rows=64)
+            assert rrec is not None and rrec["rotated"] > 0, \
+                "phase premise: the rotation must actually rotate"
+            rotations += 1
+        jids = jnp.asarray(ids)
+        rows_a, _ = act_store.lookup_tiered(jids, collect_metrics=True)
+        rows_b = ctl_store.lookup_tiered(jids)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(rows_a)),
+            np.asarray(jax.device_get(rows_b)),
+            err_msg="actuated rows diverged from the unactuated "
+                    "replay")
+    snap = aserver.snapshot()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = (sum(f._cache_size() for f in engine.jitted_fns)
+            + act_store._lookup_tiered._cache_size()) - base_cache
+    print(f"phase 13 live arrays: {base_arrays} -> {arrays}; "
+          f"actuated executable-cache growth: {grew}; "
+          f"recompiles seen by the server: {snap['recompiles']}; "
+          f"applied {act.applied} / refused {act.refused} "
+          f"(rotations {rotations})")
+    assert act.applied >= 3 + rotations and rotations == 2, \
+        "phase premise: >=3 knob swaps + 2 rotations must land"
+    assert act.refused == 1, \
+        "phase premise: the out-of-lattice point must be refused"
+    assert aserver.knobs()["batch_fill_cap"] == 64 and \
+        aserver.knobs()["max_wait_ms"] == 0.5, aserver.knobs()
+    assert grew == 0, \
+        "actuation compiled something (census safety broken)"
+    assert snap["recompiles"] == 0, \
+        "recompile watch fired across actuated swaps"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak across actuated swaps/rotations"
+    aserver.close()
+    act_store.close()
+    ctl_store.close()
+    print("no leak detected (phase 13: 50 metered steps across 3 "
+          "actuated knob swaps + 2 hot-set rotations, rows "
+          "bit-identical to the unactuated replay)")
 
 
 if __name__ == "__main__":

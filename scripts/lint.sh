@@ -93,7 +93,8 @@ def const_tuples(path, varnames):
 for path, varnames in (
         ("quiver_tpu/telemetry.py", ("DETECTOR_NAMES", "ADVICE_KEYS")),
         ("quiver_tpu/profile.py", ("PROFILE_SERIES",)),
-        ("quiver_tpu/tailsampling.py", ("TAIL_POLICY_NAMES",))):
+        ("quiver_tpu/tailsampling.py", ("TAIL_POLICY_NAMES",)),
+        ("quiver_tpu/actuator.py", ("ACTUATION_KEYS",))):
     for group, names in const_tuples(path, varnames).items():
         if not names:
             print(f"DRIFT: could not read {group} from {path}")

@@ -55,6 +55,12 @@ def _ensure_cpu_platform():
             flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # share the bench/test persistent compile cache: qt_prof runs as a
+    # subprocess in tier-1 CLI tests, and its stage programs are
+    # identical run to run
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def main(argv=None) -> int:

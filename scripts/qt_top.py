@@ -11,10 +11,13 @@ render, in place, one compact frame per refresh:
   SHEDDING highlighted);
 - recent ``anomaly`` records (highlighted red — the change-point
   detectors' verdicts), the latest ``advice`` per knob (yellow — the
-  advisory re-planner's recommendations), the latest ``regress``
-  verdicts from the bench sentinel, and ``lint`` findings from
-  ``scripts/qt_verify.py`` (ERROR red, WARN yellow — the static
-  invariant verifier's verdicts);
+  advisory re-planner's recommendations), the latest ``actuate``
+  record per knob (the actuator's ACTIONS: knob swaps plain, hot-set
+  rotations cyan, fleet scale events magenta, refused out-of-census
+  points red), the latest ``regress`` verdicts from the bench
+  sentinel, and ``lint`` findings from ``scripts/qt_verify.py``
+  (ERROR red, WARN yellow — the static invariant verifier's
+  verdicts);
 - the FLEET panel when the sink carries ``fleet`` records (point it at
   ``scripts/qt_agg.py``'s ``--jsonl``): one row per replica — health
   score colored by threshold, STALE flagged red — plus the fleet
@@ -44,6 +47,8 @@ SPARK = "▁▂▃▄▅▆▇█"
 RED = "\x1b[31m"
 YELLOW = "\x1b[33m"
 GREEN = "\x1b[32m"
+MAGENTA = "\x1b[35m"
+CYAN = "\x1b[36m"
 BOLD = "\x1b[1m"
 DIM = "\x1b[2m"
 RESET = "\x1b[0m"
@@ -77,10 +82,11 @@ def _num(v):
 
 def build_series(records):
     """kind-keyed record stream -> {series name: [values]} plus the
-    event lists (anomalies, advice, regress, lint, profile, traces,
-    slo, fleet)."""
+    event lists (anomalies, advice, act, regress, lint, profile,
+    traces, slo, fleet)."""
     series = {}
     anomalies, advice, regress, lint, prof = [], {}, {}, {}, {}
+    act = {}
     traces = {}
     slo = None
     fleet = None
@@ -140,6 +146,16 @@ def build_series(records):
             anomalies.append(rec)
         elif kind == "advice":
             advice[rec.get("key", "?")] = rec
+        elif kind == "actuate":
+            # latest per (key, action) — the lint/advice dedup
+            # discipline: a settling loop re-emits apply records per
+            # knob and must not flood the panel; the replica-count
+            # trajectory becomes a series so scale events show their
+            # trend, not just the last count
+            act[(rec.get("key", "?"), rec.get("action", "?"))] = rec
+            if rec.get("key") == "replicas":
+                put("replica_count",
+                    (rec.get("after") or {}).get("value"))
         elif kind == "regress":
             regress[(rec.get("metric", "?"),
                      rec.get("platform", "?"))] = rec
@@ -153,8 +169,8 @@ def build_series(records):
             # the same id and must render as ONE row
             if rec.get("trace_id") is not None:
                 traces[rec["trace_id"]] = rec
-    return (series, anomalies, advice, regress, lint, prof, traces,
-            slo, fleet)
+    return (series, anomalies, advice, act, regress, lint, prof,
+            traces, slo, fleet)
 
 
 def sparkline(values, width):
@@ -213,7 +229,7 @@ def render(path, limit, width, color=True, fleet_only=False):
     c = (lambda code, s: f"{code}{s}{RESET}") if color else \
         (lambda code, s: s)
     records = read_records(path, limit)
-    (series, anomalies, advice, regress, lint, prof, traces, slo,
+    (series, anomalies, advice, act, regress, lint, prof, traces, slo,
      fleet) = build_series(records)
     lines = [c(BOLD, f"qt_top — {path}  "
                      f"({len(records)} records, "
@@ -265,6 +281,21 @@ def render(path, limit, width, color=True, fleet_only=False):
                                f"{rec.get('current')} -> "
                                f"{rec.get('recommended')}  "
                                f"{rec.get('reason', '')}"))
+    # act panel: the closed loop's actions — knob swaps plain, hot-set
+    # rotation/promotion cyan, fleet scale events magenta, refusals of
+    # out-of-census points red (the WARN that must be seen)
+    for (key, action) in sorted(act):
+        rec = act[(key, action)]
+        before = (rec.get("before") or {}).get("value")
+        after = (rec.get("after") or {}).get("value")
+        tint = (RED if rec.get("level") == "WARN"
+                else MAGENTA if action in ("scale_up", "scale_down")
+                else CYAN if action in ("rotate", "promote")
+                else DIM if action == "suppress" else GREEN)
+        span = (f"{before} -> {after}" if after is not None
+                else f"{before} -> {rec.get('recommended')}")
+        lines.append(c(tint, f"  act [{key}] {action}: {span}  "
+                            f"{rec.get('reason', '')}"))
     for key in sorted(lint)[:8]:
         rec = lint[key]
         bad = rec.get("level") == "ERROR"

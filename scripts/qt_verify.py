@@ -61,6 +61,12 @@ def _ensure_cpu_platform():
     # the axon TPU bootstrap force-registers the TPU platform; the
     # config knob wins over it (same dance as tests/conftest.py)
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # share the bench/test persistent compile cache: qt_verify runs as
+    # a subprocess in several tier-1 tests, and its census compiles
+    # are identical run to run
+    jax.config.update(
+        "jax_compilation_cache_dir", os.path.join(_ROOT, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def _stdlib_analysis():

@@ -20,6 +20,18 @@ import jax
 # regardless of env vars; the config knob wins over it
 jax.config.update("jax_platforms", "cpu")
 
+# the same persistent compile cache the benches use (_common.configure_jax):
+# the tier-1 suite is compile-dominated (every jit program + every
+# subprocess test re-deriving them), and the suite has grown past its wall
+# budget paying those compiles from scratch on every run. Executables served
+# from the disk cache still register in the in-process jit caches, so the
+# recompile-counting tests see identical counts either way.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,680 @@
+"""qt-act: the advice-driven actuator that closes the observe/decide
+loop. The contracts under test:
+
+1. **Census-first knob safety** — a knob only ever swaps to a
+   pre-census'd lattice point; a recommended value outside the lattice
+   is refused LOUDLY (a WARN ``actuate`` record) and touches nothing.
+   Hysteresis: oscillating advice across a lattice boundary produces
+   at most ONE swap (and at most one ``suppress`` record) per cooldown
+   window, so flapping advice cannot flap executables.
+2. **Before/after evidence** — an applied action settles: its record
+   emits only after ``settle_s``, with the after-window observed
+   metrics sampled from the advice stream's own vocabulary.
+3. **Online hot-set rotation** — ``Actuator.maybe_rotate`` promotes
+   the hottest observed cold rows over the coldest residents through
+   ``Feature.rotate_hot_set``; lookups are BIT-identical across the
+   rotation (plain float32 AND the int8 dtype policy — the FMA decode
+   convention), a live ``ServeEngine`` keeps serving correct logits
+   after ``refresh_feature()``, and the hit census resets.
+4. **Drifting trace** — ``generate_drifting_trace`` is seeded,
+   chunk-invariant (any ``[lo, hi)`` windowing reassembles the same
+   ids bit-for-bit), and actually drifts (the popularity head moves
+   between phases).
+5. **Fleet planning** — ``HealthRouter.plan_quality`` turns mean
+   live-replica burn into a deterministic fleet-wide shed floor;
+   ``FleetAutoscaler`` grows on sustained pressure, shrinks on
+   sustained calm through the drain path, respects min/max/cooldown,
+   and records the replica-count trajectory.
+6. **Rendering** — ``qt_top`` shows the latest ``actuate`` record per
+   (key, action).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import quiver_tpu as qv
+from quiver_tpu import fleet as qf
+from quiver_tpu.actuator import (ACTUATION_KEYS, Actuator,
+                                 FleetAutoscaler, Knob,
+                                 lattice_from_census)
+from quiver_tpu.analysis.jaxpr_lint import CensusSpec
+from quiver_tpu.datasets import generate_drifting_trace
+from quiver_tpu.models import GraphSAGE
+from quiver_tpu.ops import sample_multihop
+from quiver_tpu.parallel.train import (init_state, layers_to_adjs,
+                                       masked_feature_gather)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. the drifting-popularity trace (the A/B workload)
+# ---------------------------------------------------------------------------
+
+
+class TestDriftingTrace:
+    def test_seeded_and_in_range(self):
+        a = generate_drifting_trace(5000, nodes=300, seed=11)
+        b = generate_drifting_trace(5000, nodes=300, seed=11)
+        c = generate_drifting_trace(5000, nodes=300, seed=12)
+        np.testing.assert_array_equal(a, b)
+        assert (a != c).any()
+        assert a.dtype == np.int64 and a.shape == (5000,)
+        assert a.min() >= 0 and a.max() < 300
+
+    def test_chunk_invariance(self):
+        """Generating [lo, hi) windows in ANY chunking reassembles the
+        whole trace bit-for-bit — the same pin the cold-dataset
+        generator carries (chunking is an implementation detail, never
+        part of the workload's identity)."""
+        L = 4097
+        whole = generate_drifting_trace(L, nodes=256, seed=5,
+                                        rotate_every=512)
+        for chunk in (1000, 64, 4096):
+            parts = [generate_drifting_trace(L, nodes=256, seed=5,
+                                             rotate_every=512,
+                                             lo=lo,
+                                             hi=min(lo + chunk, L))
+                     for lo in range(0, L, chunk)]
+            np.testing.assert_array_equal(np.concatenate(parts), whole)
+
+    def test_head_actually_drifts(self):
+        """The point of the trace: a hot set placed for phase 0 goes
+        stale — the phase-1 popularity head is (mostly) elsewhere."""
+        per = 4096
+        tr = generate_drifting_trace(per * 2, nodes=1000, seed=0,
+                                     rotate_every=per, hot_frac=0.05)
+        hot0 = set(np.argsort(-np.bincount(tr[:per],
+                                           minlength=1000))[:50])
+        hot1 = set(np.argsort(-np.bincount(tr[per:],
+                                           minlength=1000))[:50])
+        assert len(hot0 & hot1) < 25  # the head moved
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            generate_drifting_trace(10, nodes=0)
+        with pytest.raises(ValueError):
+            generate_drifting_trace(10, nodes=5, lo=8, hi=4)
+        assert generate_drifting_trace(10, nodes=5, lo=3,
+                                       hi=3).shape == (0,)
+
+
+# ---------------------------------------------------------------------------
+# 2. lattices: snap + census extraction
+# ---------------------------------------------------------------------------
+
+
+class TestLattice:
+    def test_snap_exact_and_float_tolerant(self):
+        k = Knob("x", read=lambda: 1, apply=lambda v: None,
+                 lattice=(1, 2, 4, 8))
+        assert k.snap(4) == 4
+        assert k.snap(3) is None
+        f = Knob("y", read=lambda: 1.0, apply=lambda v: None,
+                 lattice=(0.25, 0.5, 1.0))
+        # advice rounds through JSON: a near-exact float still snaps
+        assert f.snap(0.5 + 1e-12) == 0.5
+        assert f.snap(0.3) is None
+
+    def test_lattice_from_census(self):
+        spec = CensusSpec(axes={"variant": (1, 2, 4), "program": 3},
+                          max_programs=9)
+        assert lattice_from_census(spec, "variant") == (1, 2, 4)
+        with pytest.raises(ValueError, match="not an enumerated"):
+            lattice_from_census(spec, "program")   # a COUNT, not values
+        with pytest.raises(KeyError):
+            lattice_from_census(spec, "nope")
+
+    def test_empty_lattice_refused(self):
+        with pytest.raises(ValueError, match="empty lattice"):
+            Actuator().register(Knob("x", read=lambda: 1,
+                                     apply=lambda v: None, lattice=()))
+
+    def test_actuation_keys_documented_shape(self):
+        # the lint.sh drift contract reads this tuple; keep it a tuple
+        # of unique str keys
+        assert isinstance(ACTUATION_KEYS, tuple)
+        assert len(set(ACTUATION_KEYS)) == len(ACTUATION_KEYS)
+        assert all(isinstance(k, str) for k in ACTUATION_KEYS)
+
+
+# ---------------------------------------------------------------------------
+# 3. hysteresis + refusal + settle (pure knob, fake clock)
+# ---------------------------------------------------------------------------
+
+
+class _Hub:
+    """The minimal advice-stream stand-in: latest-per-key observed
+    blocks plus a derived snapshot (what Actuator reads from a real
+    TelemetryHub)."""
+
+    def __init__(self, derived=None):
+        self.advice = {}
+        self.derived = dict(derived or {})
+
+    def replan(self):
+        return list(self.advice.values())
+
+    def snapshot(self):
+        return {"derived": dict(self.derived)}
+
+
+def _adv(key, rec, observed=None, reason="test"):
+    return {"key": key, "current": None, "recommended": rec,
+            "observed": observed or {}, "reason": reason}
+
+
+class TestHysteresis:
+    def _act(self, **kw):
+        clk = [0.0]
+        val = [4]
+        act = Actuator(clock=lambda: clk[0], cooldown_s=30.0,
+                       settle_s=5.0, **kw)
+        act.register(Knob("batch_cap", read=lambda: val[0],
+                          apply=lambda v: val.__setitem__(0, v),
+                          lattice=(1, 2, 4, 8)))
+        return act, clk, val
+
+    def test_oscillating_advice_one_swap_per_window(self):
+        """Advice flapping across a lattice boundary every tick: ONE
+        apply per cooldown window, everything else suppressed — and at
+        most one suppress RECORD per window (no sink flood)."""
+        act, clk, val = self._act()
+        for i in range(20):
+            clk[0] = float(i)                  # 20 ticks inside one window
+            rec = 8 if val[0] == 4 else 4      # always asks to flip
+            act.tick([_adv("batch_cap", rec)])
+        assert act.applied == 1 and val[0] == 8
+        assert act.suppressed == 19
+        sup = [r for r in act.records if r["action"] == "suppress"]
+        assert len(sup) == 1
+        # the window expires: exactly one more swap
+        clk[0] = 31.0
+        out = act.tick([_adv("batch_cap", 4)])
+        assert [r["action"] for r in out
+                if r["action"] == "apply"] == ["apply"]
+        assert val[0] == 4 and act.applied == 2
+
+    def test_same_value_advice_is_a_no_op(self):
+        act, clk, val = self._act()
+        assert act.tick([_adv("batch_cap", 4)]) == []
+        assert act.applied == 0 and act.suppressed == 0
+
+    def test_out_of_lattice_refused_loudly(self):
+        """The census IS the safety proof: a point it never counted is
+        refused with a WARN record and the knob keeps its value."""
+        act, clk, val = self._act()
+        out = act.tick([_adv("batch_cap", 7)])
+        assert len(out) == 1
+        rec = out[0]
+        assert rec["action"] == "refuse" and rec["level"] == "WARN"
+        assert rec["recommended"] == 7
+        assert rec["lattice"] == [1, 2, 4, 8]
+        assert val[0] == 4 and act.applied == 0 and act.refused == 1
+        # refusals bypass cooldown state: a good point still applies
+        out = act.tick([_adv("batch_cap", 8)])
+        assert val[0] == 8
+
+    def test_apply_settles_with_after_observed(self):
+        """The before side carries the advice's observed block at
+        apply time; the after side is sampled from the advice stream
+        once settle_s elapses — only THEN does the record emit."""
+        hub = _Hub()
+        hub.advice["batch_cap"] = _adv("batch_cap", 8,
+                                       observed={"fill_p95": 3.9})
+        act, clk, val = self._act(hub=hub)
+        out = act.tick()                       # pulls hub.replan()
+        assert val[0] == 8
+        assert [r for r in act.records if r["action"] == "apply"] == []
+        hub.advice["batch_cap"] = _adv("batch_cap", 8,
+                                       observed={"fill_p95": 7.7})
+        clk[0] = 2.0
+        assert act.tick([]) == []              # not settled yet
+        clk[0] = 6.0
+        done = act.tick([])
+        assert len(done) == 1
+        rec = done[0]
+        assert rec["action"] == "apply"
+        assert rec["before"] == {"value": 4,
+                                 "observed": {"fill_p95": 3.9}}
+        assert rec["after"] == {"value": 8,
+                                "observed": {"fill_p95": 7.7}}
+
+    def test_flush_finalizes_pending_now(self):
+        act, clk, val = self._act()
+        act.tick([_adv("batch_cap", 2)])
+        assert act.snapshot()["pending"] == 1
+        done = act.flush()
+        assert len(done) == 1 and act.snapshot()["pending"] == 0
+
+    def test_records_land_on_the_sink_as_actuate(self, tmp_path):
+        sink = qv.metrics.MetricsSink(str(tmp_path / "m.jsonl"))
+        clk = [0.0]
+        val = [4]
+        act = Actuator(sink=sink, clock=lambda: clk[0], settle_s=0.0)
+        act.register(Knob("batch_cap", read=lambda: val[0],
+                          apply=lambda v: val.__setitem__(0, v),
+                          lattice=(2, 4)))
+        act.tick([_adv("batch_cap", 2)])
+        clk[0] = 1.0
+        act.tick([_adv("batch_cap", 9)])       # refuse
+        sink.close()
+        kinds = [json.loads(l) for l in
+                 open(tmp_path / "m.jsonl") if l.strip()]
+        acts = [r for r in kinds if r["kind"] == "actuate"]
+        assert [r["action"] for r in acts] == ["apply", "refuse"]
+        assert all("ts" in r for r in acts)
+
+
+# ---------------------------------------------------------------------------
+# 4. the serving knobs end-to-end (real engine + server)
+# ---------------------------------------------------------------------------
+
+N, DIM, CLASSES, CAP = 160, 8, 3, 8
+FULL, SHED = [4, 4], [1, 1]
+
+
+@pytest.fixture(scope="module")
+def world():
+    rng = np.random.default_rng(3)
+    deg = rng.integers(1, 4, N)
+    indptr = np.zeros(N + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, N, int(indptr[-1]), dtype=np.int32)
+    feat = rng.standard_normal((N, DIM)).astype(np.float32)
+    model = GraphSAGE(hidden_dim=8, out_dim=CLASSES, num_layers=2,
+                      dropout=0.0)
+    ij = jnp.asarray(indptr.astype(np.int32))
+    xj = jnp.asarray(indices)
+    n_id, layers = sample_multihop(ij, xj,
+                                   jnp.arange(4, dtype=jnp.int32),
+                                   FULL, jax.random.key(0))
+    state = init_state(model, optax.adam(1e-3),
+                       masked_feature_gather(jnp.asarray(feat), n_id),
+                       layers_to_adjs(layers, 4, FULL),
+                       jax.random.key(1))
+    return model, state.params, indptr, indices, feat
+
+
+@pytest.fixture(scope="module")
+def served(world):
+    model, params, indptr, indices, feat = world
+    topo = qv.CSRTopo(indptr=indptr, indices=indices)
+    store = qv.Feature(device_cache_size=(N // 4) * DIM * 4,
+                       csr_topo=topo)
+    store.from_cpu_tensor(feat)
+    eng = qv.ServeEngine(model, params,
+                         (jnp.asarray(indptr.astype(np.int32)),
+                          jnp.asarray(indices)),
+                         store, sizes_variants=[FULL, SHED],
+                         batch_cap=CAP)
+    eng.warmup()
+    srv = qv.MicroBatchServer(
+        eng, qv.ServeConfig(max_wait_ms=2.0, queue_depth=64,
+                            shed_queue_frac=1.0), start=False)
+    yield store, eng, srv
+    srv.close()
+    store.close()
+
+
+class TestServerKnobs:
+    def test_attach_server_default_lattices(self, served):
+        store, eng, srv = served
+        act = Actuator()
+        act.attach_server(srv)
+        assert act.knobs["batch_cap"].lattice == (1, 2, 4, 8)
+        assert 2.0 in act.knobs["max_wait_ms"].lattice
+
+    def test_attach_server_rejects_oversize_lattice(self, served):
+        store, eng, srv = served
+        with pytest.raises(ValueError, match="outside the compiled"):
+            Actuator().attach_server(srv, batch_cap_lattice=(4, 16))
+
+    def test_refused_point_leaves_the_server_untouched(self, served):
+        """An out-of-census recommendation (here: a fill cap past the
+        compiled width) produces exactly one WARN record and NO change
+        to the live server's knobs."""
+        store, eng, srv = served
+        clk = [0.0]
+        act = Actuator(clock=lambda: clk[0])
+        act.attach_server(srv)
+        before = srv.knobs()
+        out = act.tick([_adv("batch_cap", 16),
+                        _adv("max_wait_ms", 0.33)])
+        assert [r["action"] for r in out] == ["refuse", "refuse"]
+        assert all(r["level"] == "WARN" for r in out)
+        assert srv.knobs() == before and act.applied == 0
+
+    def test_applied_swaps_land_and_serve_correctly(self, served):
+        store, eng, srv = served
+        clk = [0.0]
+        act = Actuator(clock=lambda: clk[0], settle_s=0.0)
+        act.attach_server(srv)
+        act.tick([_adv("batch_cap", 4), _adv("max_wait_ms", 0.5)])
+        k = srv.knobs()
+        assert k["batch_fill_cap"] == 4 and k["max_wait_ms"] == 0.5
+        # the engine still serves: the fill cap only moved padding
+        out = np.asarray(eng.run(np.arange(4, dtype=np.int32)))
+        assert out.shape == (CAP, CLASSES)
+        assert np.isfinite(out[:4]).all()
+        srv.set_batch_fill_cap(None)           # restore
+        srv.set_max_wait_ms(2.0)
+
+
+# ---------------------------------------------------------------------------
+# 5. hot-set rotation: policy + bit-identity + engine refresh
+# ---------------------------------------------------------------------------
+
+
+def _rot_store(n=64, dim=8, cache_frac=0.25, dtype_policy=None,
+               seed=9):
+    rng = np.random.default_rng(seed)
+    deg = np.sort(rng.integers(1, 30, n))[::-1].copy()  # descending
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    indices = rng.integers(0, n, int(indptr[-1]), dtype=np.int32)
+    topo = qv.CSRTopo(indptr=indptr, indices=indices)
+    feat = rng.standard_normal((n, dim)).astype(np.float32)
+    itemsize = 1 if dtype_policy == "int8" else 4
+    store = qv.Feature(
+        device_cache_size=int(n * cache_frac) * dim * itemsize,
+        csr_topo=topo, dtype_policy=dtype_policy)
+    store.from_cpu_tensor(feat)
+    return store
+
+
+class TestRotation:
+    @pytest.mark.parametrize("policy", [None, "int8"])
+    def test_rotation_is_bit_identical(self, policy):
+        """The tentpole pin: rows decode bit-for-bit across a rotation
+        — for the quantized store this is exactly the FMA decode
+        convention (numpy computes f64-then-round, the same single
+        rounding XLA's fused multiply-add does)."""
+        store = _rot_store(dtype_policy=policy)
+        try:
+            ids = jnp.arange(64, dtype=jnp.int32)
+            before = np.asarray(store[ids])
+            clk = [100.0]
+            act = Actuator(clock=lambda: clk[0])
+            # hammer a handful of currently-cold ids
+            order = store._order_host()
+            cold = np.nonzero(order >= store.cache_rows)[0][:5]
+            for _ in range(10):
+                act.observe_ids(cold, total_rows=64)
+            rec = act.maybe_rotate(store, max_rows=8)
+            assert rec is not None and rec["rotated"] == 5
+            order2 = store._order_host()
+            assert (order2[cold] < store.cache_rows).all()
+            after = np.asarray(store[ids])
+            np.testing.assert_array_equal(before, after)
+            # metered lookup agrees bit-for-bit too and counts the
+            # promoted ids as HOT now
+            rows, c = store.lookup_tiered(jnp.asarray(cold),
+                                          collect_metrics=True)
+            np.testing.assert_array_equal(np.asarray(rows),
+                                          before[cold])
+            assert np.asarray(c)[qv.metrics.HOT_ROWS] == 5
+        finally:
+            store.close()
+
+    def test_no_profitable_pair_no_rotation(self):
+        store = _rot_store()
+        try:
+            clk = [0.0]
+            act = Actuator(clock=lambda: clk[0])
+            assert act.maybe_rotate(store) is None   # no census yet
+            hot = np.nonzero(
+                store._order_host() < store.cache_rows)[0]
+            act.observe_ids(hot, total_rows=64)      # residents win
+            assert act.maybe_rotate(store) is None
+        finally:
+            store.close()
+
+    def test_rotation_cooldown_and_census_reset(self):
+        store = _rot_store()
+        try:
+            clk = [0.0]
+            act = Actuator(clock=lambda: clk[0], cooldown_s=30.0)
+            order = store._order_host()
+            cold = np.nonzero(order >= store.cache_rows)[0][:3]
+            act.observe_ids(np.tile(cold, 5), total_rows=64)
+            assert act.maybe_rotate(store) is not None
+            assert act.hit_census() is None          # reset
+            act.observe_ids(np.tile(cold, 5), total_rows=64)
+            clk[0] = 10.0                            # inside cooldown
+            assert act.maybe_rotate(store) is None
+        finally:
+            store.close()
+
+    def test_engine_refresh_keeps_serving_truth(self, served):
+        """A live ServeEngine captured the tier arrays at build time;
+        maybe_rotate(…, engine=eng) must re-splice them so served
+        logits stay correct after the tiers moved."""
+        store, eng, srv = served
+        ref = np.asarray(eng.run(np.arange(6, dtype=np.int32)))[:6]
+        clk = [1000.0]
+        act = Actuator(clock=lambda: clk[0])
+        order = store._order_host()
+        cold = np.nonzero(order >= store.cache_rows)[0][:4]
+        for _ in range(8):
+            act.observe_ids(cold, total_rows=N)
+        rec = act.maybe_rotate(store, engine=eng, max_rows=8)
+        assert rec is not None and rec["rotated"] > 0
+        got = np.asarray(eng.run(np.arange(6, dtype=np.int32)))[:6]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 6. fleet planning + elastic autoscaling (fake clock, fake processes)
+# ---------------------------------------------------------------------------
+
+
+def _snap(burns, stale=()):
+    return {"replicas": {
+        f"r{i}": {"stale": i in stale,
+                  "components": {"burn": b, "stale": i in stale}}
+        for i, b in enumerate(burns)}}
+
+
+class TestPlanQuality:
+    def test_no_voters_floor_zero(self):
+        plan = qf.HealthRouter.plan_quality({}, ladder=3)
+        assert plan["shed_floor"] == 0 and plan["considered"] == 0
+
+    def test_mean_burn_steps_the_floor(self):
+        # mean 2.0 -> excess 1.0 -> ceil(1.0/0.5) = 2 steps
+        plan = qf.HealthRouter.plan_quality(_snap([1.5, 2.5]),
+                                            ladder=3)
+        assert plan["shed_floor"] == 2
+        assert plan["burn_mean"] == pytest.approx(2.0)
+        assert plan["burn_max"] == pytest.approx(2.5)
+
+    def test_one_hot_replica_is_routing_not_degradation(self):
+        # one replica at burn 3, three sustainable: mean 1.125 ->
+        # floor 1, NOT the panic floor burn_max alone would argue
+        plan = qf.HealthRouter.plan_quality(
+            _snap([3.0, 0.5, 0.5, 0.5]), ladder=3)
+        assert plan["shed_floor"] == 1
+
+    def test_stale_replicas_do_not_vote(self):
+        plan = qf.HealthRouter.plan_quality(
+            _snap([9.0, 0.5], stale={0}), ladder=3)
+        assert plan["shed_floor"] == 0 and plan["stale_count"] == 1
+
+    def test_capped_at_ladder(self):
+        plan = qf.HealthRouter.plan_quality(_snap([9.0]), ladder=2)
+        assert plan["shed_floor"] == 2
+
+    def test_plan_fleet_applies_under_cooldown(self, served):
+        store, eng, srv = served
+        clk = [0.0]
+        act = Actuator(clock=lambda: clk[0], cooldown_s=30.0)
+        rec = act.plan_fleet(srv, _snap([2.0, 2.0]))
+        assert rec is not None and rec["key"] == "fleet_shed"
+        assert srv.knobs()["shed_floor"] == 1    # ladder depth 1
+        # oscillating burn inside the window: suppressed, floor holds
+        clk[0] = 5.0
+        assert act.plan_fleet(srv, _snap([0.1])) is None
+        assert srv.knobs()["shed_floor"] == 1
+        clk[0] = 31.0
+        rec = act.plan_fleet(srv, _snap([0.1]))
+        assert rec is not None and srv.knobs()["shed_floor"] == 0
+
+
+class _FakeProc:
+    def __init__(self):
+        self.pid = 1
+        self._rc = None
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        if self._rc is None:
+            self._rc = 0
+
+    def kill(self):
+        self._rc = -9
+
+    def send_signal(self, sig):
+        self._rc = -int(sig)
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+class TestFleetAutoscaler:
+    def _rig(self, **kw):
+        clk = [0.0]
+        sup = qf.ReplicaSupervisor(
+            lambda name, index, attempt: _FakeProc(), 2,
+            grace_s=0.0, clock=lambda: clk[0])
+        sup.step()                               # spawn r0, r1
+        router = qf.HealthRouter(names=["r0", "r1"])
+        kw.setdefault("sustain", 2)
+        kw.setdefault("calm", 3)
+        kw.setdefault("cooldown_s", 10.0)
+        kw.setdefault("drain_wait_s", 0.0)
+        kw.setdefault("max_replicas", 3)
+        sc = FleetAutoscaler(sup, router=router,
+                             clock=lambda: clk[0], **kw)
+        return sc, sup, router, clk
+
+    def test_scale_up_needs_sustained_pressure(self):
+        sc, sup, router, clk = self._rig()
+        assert sc.step(_snap([2.0, 2.0])) is None   # 1 hot poll
+        clk[0] = 1.0
+        rec = sc.step(_snap([2.0, 2.0]))            # 2nd: acts
+        assert rec is not None and rec["action"] == "scale_up"
+        assert rec["before"]["value"] == 2
+        assert rec["after"]["value"] == 3
+        assert sup.replica_count == 3
+        sup.step()                                   # the new one spawns
+        assert sup.status()["r2"]["alive"]
+        # max_replicas holds
+        clk[0] = 20.0
+        sc.step(_snap([9.0] * 3))
+        clk[0] = 21.0
+        assert sc.step(_snap([9.0] * 3)) is None
+        assert sup.replica_count == 3
+
+    def test_queue_depth_alone_is_pressure(self):
+        sc, sup, router, clk = self._rig()
+        sc.step(_snap([0.1, 0.1]), queue_depth=50)
+        clk[0] = 1.0
+        rec = sc.step(_snap([0.1, 0.1]), queue_depth=50)
+        assert rec is not None and rec["action"] == "scale_up"
+        assert rec["before"]["observed"]["queue_depth"] == 50
+
+    def test_scale_down_drains_then_forgets(self):
+        sc, sup, router, clk = self._rig(min_replicas=1)
+        for i in range(3):                           # calm=3 quiet polls
+            clk[0] = float(i)
+            rec = sc.step(_snap([0.1, 0.1]), queue_depth=0)
+        assert rec is not None and rec["action"] == "scale_down"
+        assert rec["replicas"] == ["r1"]             # newest retires
+        assert sup.replica_count == 1
+        assert "r1" not in router.snapshot()["scores"]  # forgotten
+        ev = [e["event"] for e in sup.events]
+        assert ev.count("scale_down") == 1
+        # the retirement is not a crash: no restart scheduled
+        sup.step()
+        assert set(sup.status()) == {"r0"}
+
+    def test_min_replicas_and_cooldown_hold(self):
+        sc, sup, router, clk = self._rig(min_replicas=2)
+        for i in range(8):
+            clk[0] = float(i)
+            assert sc.step(_snap([0.1, 0.1]), queue_depth=0) is None
+        assert sup.replica_count == 2                # floor holds
+        sc2, sup2, router2, clk2 = self._rig(min_replicas=1, calm=1)
+        clk2[0] = 1.0
+        assert sc2.step(_snap([0.1, 0.1]),
+                        queue_depth=0) is not None
+        clk2[0] = 2.0                                # inside cooldown
+        assert sc2.step(_snap([0.1]), queue_depth=0) is None
+
+    def test_trajectory_records_every_step(self):
+        sc, sup, router, clk = self._rig()
+        for i in range(4):
+            clk[0] = float(i)
+            sc.step(_snap([2.0, 2.0]))
+        assert sc.trajectory[:2] == [2, 2]
+        assert sc.trajectory[-1] == 3                # grew after sustain
+
+    def test_supervisor_refuses_total_shrink(self):
+        sup = qf.ReplicaSupervisor(
+            lambda name, index, attempt: _FakeProc(), 1,
+            clock=lambda: 0.0)
+        sup.step()
+        with pytest.raises(ValueError, match="at least one"):
+            sup.shrink(1)
+
+
+# ---------------------------------------------------------------------------
+# 7. qt_top renders the act panel
+# ---------------------------------------------------------------------------
+
+
+class TestQtTopActPanel:
+    SCRIPT = os.path.join(REPO, "scripts", "qt_top.py")
+
+    def test_latest_record_per_key_action(self, tmp_path):
+        p = tmp_path / "m.jsonl"
+        recs = [
+            {"kind": "actuate", "key": "batch_cap", "action": "apply",
+             "before": {"value": 8}, "after": {"value": 4},
+             "reason": "stale"},
+            {"kind": "actuate", "key": "batch_cap", "action": "apply",
+             "before": {"value": 4}, "after": {"value": 2},
+             "reason": "mostly padding"},
+            {"kind": "actuate", "key": "max_wait_ms",
+             "action": "refuse", "level": "WARN", "recommended": 0.33,
+             "before": {"value": 2.0}, "reason": "outside lattice"},
+            {"kind": "actuate", "key": "hot_set", "action": "rotate",
+             "before": {"value": None}, "after": {"value": 12},
+             "reason": "drift"},
+            {"kind": "actuate", "key": "replicas",
+             "action": "scale_up", "before": {"value": 2},
+             "after": {"value": 3}, "reason": "pressure"},
+        ]
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        out = subprocess.run(
+            [sys.executable, self.SCRIPT, "--once", "--no-color",
+             "--jsonl", str(p)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        # deduped: only the NEWEST batch_cap apply renders
+        assert "act [batch_cap] apply: 4 -> 2" in out.stdout
+        assert "8 -> 4" not in out.stdout
+        assert "act [max_wait_ms] refuse: 2.0 -> 0.33" in out.stdout
+        assert "act [hot_set] rotate" in out.stdout
+        assert "act [replicas] scale_up: 2 -> 3" in out.stdout

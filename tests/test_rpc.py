@@ -578,3 +578,111 @@ class TestChaosKillFleet:
         assert lats, "no latencies recorded"
         p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)]
         assert p99 < 2.0, f"accepted p99 {p99:.3f}s unbounded"
+
+
+# ---------------------------------------------------------------------------
+# the qt-act scale-down gate — retire a replica at sustained load,
+# prove the drain -> wait -> retire choreography loses ZERO requests
+# ---------------------------------------------------------------------------
+
+
+class TestScaleDownZeroLoss:
+    def test_mid_load_retirement_resolves_every_request(self, tmp_path):
+        names = ["r0", "r1", "r2"]
+        ports = dict(zip(names, free_ports(3)))
+        sinks = {n: str(tmp_path / f"{n}.jsonl") for n in names}
+        ev_path = str(tmp_path / "events.jsonl")
+        ev_sink = qm.MetricsSink(ev_path)
+
+        def spawn(name, index, attempt):
+            return subprocess.Popen(
+                [sys.executable, "-c", _REPLICA, REPO, name,
+                 str(ports[name]), sinks[name]],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        sup = qf.ReplicaSupervisor(
+            spawn, 3, names=names, monitor_interval_s=0.05,
+            grace_s=1.0, sink=ev_sink).start()
+        router = qf.HealthRouter(names, seed=3)
+        cli = qrpc.RpcClient(
+            {n: ("127.0.0.1", p) for n, p in ports.items()},
+            router=router, timeout_ms=400.0, retries=3,
+            backoff_ms=20.0, backoff_cap_ms=150.0,
+            hedge=True, hedge_delay_ms=60.0, seed=5)
+        retired: list = []
+        try:
+            deadline = time.monotonic() + 20.0
+            up = set()
+            while time.monotonic() < deadline and len(up) < 3:
+                for n in names:
+                    if n not in up:
+                        try:
+                            if cli.ping(n, timeout_ms=300)["ok"]:
+                                up.add(n)
+                        except Exception:
+                            pass
+                time.sleep(0.05)
+            assert up == set(names), f"fleet never came up: {up}"
+
+            # sustained open-loop load; mid-stream the autoscaler path
+            # retires r2 — shrink() drains it through the router, waits
+            # out the in-flight window, removes it from the supervised
+            # set (no resurrection), THEN signals it. The shrink runs
+            # on its own thread exactly as FleetAutoscaler.step would
+            # against live traffic.
+            def retire():
+                retired.extend(sup.shrink(
+                    names=["r2"], drain=router.drain,
+                    drain_wait_s=0.3))
+                router.forget("r2")
+
+            shrinker = threading.Thread(target=retire, daemon=True)
+            futs = []
+            t0 = time.perf_counter()
+            for k in range(160):
+                target = t0 + k * 0.015
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                if k == 50:
+                    shrinker.start()
+                futs.append((k, cli.lookup_future(k % 50,
+                                                  budget_ms=8000.0)))
+            shrinker.join(timeout=30)
+            assert not shrinker.is_alive()
+
+            # THE gate: zero requests lost to the retirement
+            failed = []
+            for k, fut in futs:
+                try:
+                    row = fut.result(timeout=60)
+                    np.testing.assert_array_equal(row, fake_row(k % 50))
+                except qrpc.RpcError as e:
+                    failed.append((k, type(e).__name__))
+            assert not failed, f"requests lost to scale-down: {failed}"
+
+            # the fleet really shrank — and STAYS shrunk (a retirement
+            # is not a crash: the monitor must not resurrect r2)
+            assert retired == ["r2"]
+            assert sup.replica_count == 2
+            time.sleep(0.3)                     # a few monitor passes
+            st = sup.status()
+            assert set(st) == {"r0", "r1"} and \
+                all(v["alive"] for v in st.values())
+            assert "r2" not in router.snapshot()["scores"]
+        finally:
+            cli.close()
+            sup.close()
+            ev_sink.close()
+
+        events = qm.read_jsonl(ev_path)
+        downs = [r for r in events if r.get("kind") == "chaos"
+                 and r.get("event") == "scale_down"]
+        assert len(downs) == 1
+        assert downs[0]["replicas"] == ["r2"] and downs[0]["drained"]
+        assert downs[0]["count"] == 2
+        # no exit/restart bookkeeping for the victim: retirement left
+        # the supervised set BEFORE the process died
+        assert not [r for r in events if r.get("kind") == "chaos"
+                    and r.get("replica") == "r2"
+                    and r.get("event") in ("exit", "restart")]
