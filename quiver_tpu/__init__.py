@@ -54,7 +54,8 @@ from .datasets import (GraphDataset, from_numpy_dir,
 from .pipeline import Pipeline, pipelined
 from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
-                      ServeEngine, build_serve_step)
+                      ServeEngine, ShardedServeEngine,
+                      build_serve_step, build_sharded_serve_step)
 from .tailsampling import TailSampler, TraceStore
 from .telemetry import FlightRecorder, PlanContext, TelemetryHub
 from .profile import StageProfiler, machine_probe
@@ -134,7 +135,9 @@ __all__ = [
     "OverloadError",
     "ServeConfig",
     "ServeEngine",
+    "ShardedServeEngine",
     "build_serve_step",
+    "build_sharded_serve_step",
     "TailSampler",
     "TraceStore",
     "TelemetryHub",

@@ -230,6 +230,55 @@ def _serve_step() -> List[EntrySpec]:
         for v, step in enumerate(engine._steps)]
 
 
+def _sharded_serve_step() -> List[EntrySpec]:
+    import numpy as np
+    import jax
+    from ..feature import DistFeature, PartitionInfo
+    from ..comm import TpuComm
+    from ..serving import ShardedServeEngine
+    fx = _fixture()
+    h = len(jax.devices())
+    cap = 16
+    mesh = _mesh("host")
+    # identity partition: global id g lives at (host g//rows, row g%rows)
+    rows = fx.n // h
+    g2h = (np.arange(fx.n) // rows).astype(np.int32)
+    info = PartitionInfo(host=0, hosts=h, global2host=g2h)
+    comm = TpuComm(rank=0, world_size=h, mesh=mesh, axis="host")
+    dist = DistFeature.from_partition(np.asarray(fx.feat), info, comm,
+                                      exchange_cap=cap)
+    engine = ShardedServeEngine(fx.model, fx.state.params,
+                                (fx.indptr, fx.indices), dist,
+                                sizes_variants=[[3, 2], [2, 1], [1, 1]],
+                                batch_cap=16, home=0,
+                                collect_metrics=True)
+    seeds = jax.numpy.asarray(engine.pad_seeds(list(range(8))))
+    args = (engine.params, engine._key, dist._spmd_feat, engine._g2h,
+            engine._g2l, engine._indptr, engine._indices, seeds)
+    census = CensusSpec({"fanout_variant": tuple(
+        tuple(v) for v in engine.variants)}, max_programs=4)
+
+    def budget(sizes):
+        frontier = _frontier_cap(engine.batch_cap, sizes)
+        dense = h * frontier * 4 + h * frontier * fx.dim * 4
+        return {"prims": ("all_to_all",), "dense_bytes": dense,
+                "max_frac": 0.25,
+                "dense_shapes": ((h, frontier), (h, frontier, fx.dim))}
+
+    # EVERY ladder variant is traced (each is its own shard_map program
+    # over the partitioned store); the census rides the primary once
+    return [EntrySpec(
+        name="sharded_serve_step" if v == 0
+        else f"sharded_serve_step[variant{v}]",
+        fn=step, args=args,
+        donate_argnums=(1,),        # the threaded PRNG key chain
+        exchange=budget(engine.variants[v]),
+        census=census if v == 0 else None,
+        detail={"batch_cap": engine.batch_cap, "exchange_cap": cap,
+                "home": engine.home, "fanout": engine.variants[v]})
+        for v, step in enumerate(engine._steps)]
+
+
 def _rows_view():
     """The exact-mode wide-path layout view of the fixture's indices
     (what callers pass as ``indices_rows``) — lets the rows arity of
@@ -353,6 +402,7 @@ register_entry("train_step", _train_step, quick=True)
 register_entry("lookup_tiered", _lookup_tiered, quick=True)
 register_entry("dist_lookup", _dist_lookup, quick=True)
 register_entry("serve_step", _serve_step, quick=True)
+register_entry("sharded_serve_step", _sharded_serve_step, quick=True)
 register_entry("fused_hot_hop", _fused_hot_hop, quick=True)
 register_entry("e2e_train_step", _e2e_train_step)
 register_entry("dist_train_step", _dist_train_step)

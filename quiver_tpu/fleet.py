@@ -310,6 +310,8 @@ class FleetAggregator:
         for r in self._replicas.values():
             since = r.last_new if r.last_new is not None \
                 else self._t_start
+            serving = ((r.last_serving or {}).get("serving") or {})
+            derived = ((r.last_serving or {}).get("derived") or {})
             reps[r.name] = {
                 "path": r.path,
                 "health": r.health,
@@ -318,6 +320,12 @@ class FleetAggregator:
                 "records": r.records,
                 "components": dict(r.components),
                 "meta": r.meta,
+                # qt-shard: partition ownership + the locality payoff,
+                # straight off the replica's newest serving record —
+                # what qt_top's fleet panel and the locality router's
+                # operators pivot on
+                "partition": serving.get("partition"),
+                "locality_hit_rate": derived.get("locality_hit_rate"),
             }
         healths = [v["health"] for v in reps.values()]
         n_stale = sum(1 for v in reps.values() if v["stale"])
@@ -423,7 +431,27 @@ class HealthRouter:
 
     Scores arrive via :meth:`update` / :meth:`sync`; unknown replicas
     auto-register (score 1.0 until told otherwise). ``snapshot()``
-    is one JSONL-ready dict."""
+    is one JSONL-ready dict.
+
+    **Partition-aware locality routing** (qt-shard): after
+    :meth:`set_locality`, a ``seed``-carrying :meth:`pick` /
+    :meth:`ranked` blends each replica's health with the degree-mass
+    fraction of that request's expected frontier resident in the
+    replica's partition's HOT tier
+    (``partition.build_locality_table`` — the ``plan_hot_capacity``
+    math applied per partition)::
+
+        effective(name) = health(name)
+                          * ((1 - w) + w * table[seed, owner(name)])
+
+    The router IS the cache policy: a request lands on the replica
+    whose hot tier already holds most of its frontier, so the sharded
+    engine's exchange ships fewer remote rows (measurably lower
+    ``locality_miss_rows``) — while health keeps its veto (a locality
+    factor can only scale a replica's weight DOWN toward ``1 - w``,
+    never resurrect a drained or dying one; drain hysteresis runs on
+    raw health, untouched). Seed-less calls (and health-only routers)
+    behave exactly as before."""
 
     def __init__(self, names: Sequence[str] = (), seed: int = 0,
                  drain_below: float = 0.25, readmit_above: float = 0.5):
@@ -440,6 +468,11 @@ class HealthRouter:
         self.picks = 0
         self.drains = 0
         self.readmits = 0
+        # locality state (set_locality): [n, partitions] degree-mass
+        # table, replica -> partition ownership, blend weight
+        self._loc_table = None
+        self._loc_owners: Dict[str, int] = {}
+        self._loc_weight = 0.0
 
     def update(self, name: str, score: float) -> None:
         """Fold one replica's health score (clamped to [0, 1]) and run
@@ -484,6 +517,45 @@ class HealthRouter:
             self._scores.pop(str(name), None)
             self._drained.discard(str(name))
 
+    def set_locality(self, table, owners: Dict[str, int],
+                     weight: float = 0.5) -> None:
+        """Arm partition-aware routing: ``table`` is the
+        ``[n, partitions]`` degree-mass locality table
+        (``partition.build_locality_table``), ``owners`` maps replica
+        name -> owned partition, ``weight`` in [0, 1) is the blend
+        (0 restores pure health routing; 1 is refused — health must
+        keep its veto). Replicas absent from ``owners`` route with a
+        NEUTRAL locality factor of 1 (they are never penalized for
+        what the router doesn't know)."""
+        weight = float(weight)
+        if not 0.0 <= weight < 1.0:
+            raise ValueError(
+                f"locality weight must be in [0, 1), got {weight}")
+        import numpy as _np
+        table = None if table is None else _np.asarray(table)
+        if table is not None and table.ndim != 2:
+            raise ValueError(
+                f"locality table must be [n, partitions], got shape "
+                f"{table.shape}")
+        with self._lock:
+            self._loc_table = table
+            self._loc_owners = {str(k): int(v)
+                                for k, v in (owners or {}).items()}
+            self._loc_weight = weight if table is not None else 0.0
+
+    def _locality(self, name: str, seed) -> float:
+        """Locality factor in [1 - w, 1] (lock held)."""
+        w = self._loc_weight
+        t = self._loc_table
+        if w <= 0.0 or t is None or seed is None:
+            return 1.0
+        part = self._loc_owners.get(name)
+        s = int(seed)
+        if part is None or not 0 <= s < t.shape[0] \
+                or not 0 <= part < t.shape[1]:
+            return 1.0
+        return (1.0 - w) + w * float(t[s, part])
+
     def _active(self, exclude) -> Tuple[List[str], List[str]]:
         ex = set(exclude)
         active = [n for n in self._scores
@@ -492,31 +564,38 @@ class HealthRouter:
                 if n not in ex and n not in active]
         return active, rest
 
-    def ranked(self, exclude: Sequence[str] = ()) -> List[str]:
+    def ranked(self, exclude: Sequence[str] = (),
+               seed=None) -> List[str]:
         """Replicas healthiest-first; drained ones LAST (a retry path
         may still try them when nothing healthy remains). Excluded
         names (this request's already-failed replicas) drop entirely
-        unless that would leave nothing."""
+        unless that would leave nothing. ``seed`` (the request's node
+        id) folds the locality blend into the order when
+        :meth:`set_locality` armed it."""
         with self._lock:
+            key = lambda n: (-self._scores[n] * self._locality(n, seed),
+                             n)
             active, rest = self._active(exclude)
-            out = (sorted(active, key=lambda n: (-self._scores[n], n))
-                   + sorted(rest, key=lambda n: (-self._scores[n], n)))
+            out = sorted(active, key=key) + sorted(rest, key=key)
             if not out:
-                out = sorted(self._scores,
-                             key=lambda n: (-self._scores[n], n))
+                out = sorted(self._scores, key=key)
             return out
 
-    def pick(self, exclude: Sequence[str] = ()) -> str:
+    def pick(self, exclude: Sequence[str] = (), seed=None) -> str:
         """One replica, drawn with probability proportional to health
         among the non-drained set (a replica at health 0.3 takes 3x
         less traffic than one at 0.9 — shed pressure routes AWAY
-        before the SLO blows, the planned trade)."""
+        before the SLO blows, the planned trade). ``seed`` (the
+        request's node id) scales each weight by the locality blend
+        when :meth:`set_locality` armed it — the hot-set-aware draw
+        that makes the router the cache policy."""
         with self._lock:
             active, rest = self._active(exclude)
             pool = active or rest or list(self._scores)
             if not pool:
                 raise ValueError("router knows no replicas")
-            weights = [max(self._scores.get(n, 1.0), 1e-6)
+            weights = [max(self._scores.get(n, 1.0)
+                           * self._locality(n, seed), 1e-6)
                        for n in pool]
             total = sum(weights)
             x = self._rng.random() * total
@@ -529,10 +608,14 @@ class HealthRouter:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"scores": dict(self._scores),
-                    "drained": sorted(self._drained),
-                    "picks": self.picks, "drains": self.drains,
-                    "readmits": self.readmits}
+            out = {"scores": dict(self._scores),
+                   "drained": sorted(self._drained),
+                   "picks": self.picks, "drains": self.drains,
+                   "readmits": self.readmits}
+            if self._loc_table is not None and self._loc_weight > 0.0:
+                out["locality"] = {"weight": self._loc_weight,
+                                   "owners": dict(self._loc_owners)}
+            return out
 
     @staticmethod
     def plan_quality(snapshot: dict, ladder: int,

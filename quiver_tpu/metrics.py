@@ -103,8 +103,10 @@ IO_DEPTH_PEAK = 19    # peak in-flight read requests observed [max slot]
 IO_RETRIES = 20       # transient cold-IO read retries (EINTR/EAGAIN/EIO)
 FAULTS_INJECTED = 21  # faults the armed FaultPlan fired (process-wide)
 STAGING_RESTARTS = 22  # staging workers auto-replaced / shards retried
+LOCALITY_HIT_ROWS = 23   # frontier rows owned by the serving home partition
+LOCALITY_MISS_ROWS = 24  # frontier rows owned elsewhere (exchange-remote)
 
-NUM_COUNTERS = 23
+NUM_COUNTERS = 25
 
 #: slots merged with ``max`` across steps/shards; all others add
 MAX_SLOTS = (EXCH_BUCKET_MAX, EXCH_CAP, IO_DEPTH_PEAK)
@@ -127,6 +129,8 @@ SLOT_NAMES = {
     IO_RETRIES: "io_retries",
     FAULTS_INJECTED: "faults_injected",
     STAGING_RESTARTS: "staging_worker_restarts",
+    LOCALITY_HIT_ROWS: "locality_hit_rows",
+    LOCALITY_MISS_ROWS: "locality_miss_rows",
 }
 
 _MAX_MASK_NP = np.zeros((NUM_COUNTERS,), bool)
@@ -255,6 +259,9 @@ def derive(counters) -> Dict[str, Optional[float]]:
             c[PREFETCH_HIT_ROWS],
             c[PREFETCH_HIT_ROWS] + c[PREFETCH_SYNC_ROWS]),
         "io_coalescing_factor": ratio(c[IO_READ_ROWS], c[IO_EXTENTS]),
+        "locality_hit_rate": ratio(
+            c[LOCALITY_HIT_ROWS],
+            c[LOCALITY_HIT_ROWS] + c[LOCALITY_MISS_ROWS]),
     }
 
 

@@ -350,3 +350,125 @@ def load_disk_tier_store(result_path: str, hot_rows: int = 0,
     if prefetch_rows:
         store.enable_cold_prefetch(prefetch_rows, **prefetch_kwargs)
     return store, meta
+
+
+# -- partition-placement artifacts (qt-shard) -------------------------------
+# Serving replicas over ONE partitioned graph need the placement maps
+# (owner array, replicated set) and the degree-mass ownership tables the
+# locality router scores with — WITHOUT re-running the partitioner at
+# every replica boot. Same meta discipline as the tiers above: a "kind"
+# discriminator plus recorded shapes, and the loader refuses a mismatch
+# instead of mis-decoding.
+
+def save_partition_info(info, result_path: str, overwrite: bool = False):
+    """Persist a ``feature.PartitionInfo``'s placement::
+
+        result_path/partition_info.npz      (global2host [+ replicate])
+        result_path/partition_info.json     (kind, hosts, host, nodes)
+
+    ``load_partition_info(result_path)`` round-trips it (each serving
+    replica passes its own ``host=`` — the placement is host-agnostic,
+    only the replica-tail base differs)."""
+    os.makedirs(result_path, exist_ok=True)
+    npz_path = os.path.join(result_path, "partition_info.npz")
+    if os.path.exists(npz_path) and not overwrite:
+        raise FileExistsError(
+            f"{npz_path} exists; pass overwrite=True to replace it")
+    g2h = np.asarray(info.global2host).astype(np.int32)
+    arrays = {"global2host": g2h}
+    if info.replicate is not None:
+        arrays["replicate"] = np.asarray(info.replicate).astype(np.int32)
+    np.savez(npz_path, **arrays)
+    meta = {"kind": "partition_info", "hosts": int(info.hosts),
+            "host": int(info.host), "nodes": int(g2h.shape[0]),
+            "has_replicate": info.replicate is not None}
+    with open(os.path.join(result_path, "partition_info.json"), "w") as fh:
+        json.dump(meta, fh)
+    return meta
+
+
+def load_partition_info(result_path: str, host=None):
+    """Load a :func:`save_partition_info` artifact back into a
+    ``feature.PartitionInfo`` (``host`` overrides the recorded one — a
+    replica fleet shares one artifact, each boot naming its own slot).
+    Refuses an artifact whose arrays no longer match their recorded
+    meta."""
+    from .feature import PartitionInfo
+
+    with open(os.path.join(result_path, "partition_info.json")) as fh:
+        meta = json.load(fh)
+    if meta.get("kind") != "partition_info":
+        raise ValueError(
+            f"{result_path} holds a {meta.get('kind', 'partition')!r} "
+            "artifact, not a partition_info one")
+    npz = np.load(os.path.join(result_path, "partition_info.npz"))
+    g2h = npz["global2host"]
+    if g2h.shape[0] != meta["nodes"] or \
+            (("replicate" in npz.files) != meta["has_replicate"]):
+        raise ValueError(
+            f"{result_path}/partition_info.npz does not match its meta "
+            f"({g2h.shape[0]} nodes vs recorded {meta['nodes']}) — "
+            "refusing to mis-decode")
+    if int(g2h.max(initial=0)) >= meta["hosts"]:
+        raise ValueError(
+            f"{result_path}: global2host names host {int(g2h.max())} "
+            f"but meta records only {meta['hosts']} hosts — refusing "
+            "to mis-decode")
+    rep = npz["replicate"] if meta["has_replicate"] else None
+    return PartitionInfo(host=int(meta["host"] if host is None else host),
+                         hosts=int(meta["hosts"]), global2host=g2h,
+                         replicate=rep)
+
+
+def partition_hot_mask(global2host, hot_rows, degree) -> np.ndarray:
+    """Boolean [n] mask of each partition's hot tier: the top
+    ``hot_rows`` nodes BY DEGREE within each partition (the
+    ``quant.plan_hot_capacity`` placement, applied per partition).
+    ``hot_rows`` is an int (same capacity everywhere) or a per-partition
+    sequence."""
+    g2h = np.asarray(global2host)
+    deg = np.asarray(degree, np.float64)
+    hosts = int(g2h.max(initial=0)) + 1
+    caps = ([int(hot_rows)] * hosts if np.isscalar(hot_rows)
+            else [int(c) for c in hot_rows])
+    hot = np.zeros(g2h.shape[0], bool)
+    for p in range(hosts):
+        owned = np.flatnonzero(g2h == p)
+        order = np.argsort(-deg[owned], kind="stable")[:max(caps[p], 0)]
+        hot[owned[order]] = True
+    return hot
+
+
+def build_locality_table(indptr, indices, global2host, hot_rows,
+                         degree=None, include_self: bool = True):
+    """Degree-mass locality table [n, hosts] for the partition-aware
+    router: ``table[v, p]`` is the fraction of node ``v``'s expected
+    1-hop frontier degree mass resident in partition ``p``'s HOT tier
+    (neighbors weighted by ``degree + 1`` — minibatch frontiers hit
+    nodes degree-proportionally, and the +1 keeps leaves visible;
+    ``include_self`` adds the seed's own row). Rows sum to at most 1;
+    mass outside every hot tier is nobody's locality win. A request for
+    seed ``v`` routed to the replica owning ``argmax(table[v])`` finds
+    the most frontier rows already resident — the router blends this
+    with health (``fleet.HealthRouter.set_locality``)."""
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices)
+    g2h = np.asarray(global2host)
+    n = indptr.shape[0] - 1
+    hosts = int(g2h.max(initial=0)) + 1
+    deg = (indptr[1:] - indptr[:-1]).astype(np.float64) \
+        if degree is None else np.asarray(degree, np.float64)
+    hot = partition_hot_mask(g2h, hot_rows, deg)
+    mass = deg + 1.0
+    hot_mass = np.where(hot, mass, 0.0)
+    acc = np.zeros((n, hosts), np.float64)
+    total = np.zeros(n, np.float64)
+    src = np.repeat(np.arange(n), (indptr[1:] - indptr[:-1]))
+    dst = indices[:src.shape[0]]
+    np.add.at(acc, (src, g2h[dst]), hot_mass[dst])
+    np.add.at(total, src, mass[dst])
+    if include_self:
+        np.add.at(acc, (np.arange(n), g2h), hot_mass)
+        total += mass
+    table = acc / np.maximum(total, 1e-12)[:, None]
+    return table.astype(np.float32)

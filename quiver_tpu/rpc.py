@@ -564,20 +564,32 @@ class RpcClient:
         self._loop.close()
 
     # -- routing -------------------------------------------------------------
-    def _ranked(self, exclude: Sequence[str]) -> List[str]:
+    def _ranked(self, exclude: Sequence[str],
+                seed=None) -> List[str]:
         """Replicas to try for one attempt. With a router: the PRIMARY
         is a health-WEIGHTED pick (load spreads away from pressed
         replicas), the rest follow healthiest-first (what the hedge
         and any fallback walk). Without one, a deterministic rotation
-        spreads load."""
+        spreads load. ``seed`` (the request's node id) is forwarded to
+        locality-aware routers so partition ownership biases the draw;
+        routers without the kwarg keep working (pure health)."""
         names = [n for n in self.addrs if n not in exclude]
         if not names:
             names = list(self.addrs)     # all excluded: try anyway
         if self.router is not None:
-            ranked = [n for n in self.router.ranked(exclude=exclude)
-                      if n in self.addrs]
             try:
-                primary = self.router.pick(exclude=exclude)
+                ranked = [n for n in self.router.ranked(
+                              exclude=exclude, seed=seed)
+                          if n in self.addrs]
+            except TypeError:            # router without seed kwarg
+                ranked = [n for n in self.router.ranked(exclude=exclude)
+                          if n in self.addrs]
+            try:
+                try:
+                    primary = self.router.pick(exclude=exclude,
+                                               seed=seed)
+                except TypeError:        # router without seed kwarg
+                    primary = self.router.pick(exclude=exclude)
             except ValueError:
                 primary = None
             if primary in self.addrs:
@@ -779,7 +791,7 @@ class RpcClient:
                     raise DeadlineExceeded(
                         f"budget spent after {attempt} attempts "
                         f"({[type(c).__name__ for c in causes]})")
-            names = self._ranked(exclude=tried)
+            names = self._ranked(exclude=tried, seed=node)
             with self._lock:
                 self._stats["attempts"] += 1
                 if attempt:

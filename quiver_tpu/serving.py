@@ -447,6 +447,212 @@ def _feature_gather(feature):
     return (feature.device_part, host), feature.feature_order, gather
 
 
+# -- sharded serving: one partitioned store under the whole fleet ------------
+
+
+def build_sharded_serve_step(model, sizes: Sequence[int], batch_cap: int,
+                             mesh, axis: str, rows_per_host: int,
+                             method: str = "exact",
+                             exchange_cap=None,
+                             home: Optional[int] = None,
+                             collect_metrics: bool = False):
+    """The serve step over a ``DistFeature``-partitioned store: ONE
+    jitted ``shard_map`` program per fanout config whose gather stage is
+    the PR 4 compact deduplicated exchange (``comm.dist_lookup_local``)
+    instead of a resident-array read.
+
+    Returns ``step(params, key, spmd_feat, g2h, g2l, indptr, indices,
+    seeds)`` -> ``(next_key, logits[batch_cap, out_dim])`` (plus the
+    GLOBAL ``[metrics.NUM_COUNTERS]`` vector with ``collect_metrics``,
+    ``pmerge_counters``-folded over the mesh axis on device).
+    ``spmd_feat`` is the ``P(axis)``-sharded ``[H*rows_per_host, dim]``
+    store (``DistFeature._spmd_feat``); everything else — topology,
+    placement maps, the ``[batch_cap]`` seed block — is replicated, and
+    sampling runs REPLICATED (no per-shard key fold), so the frontier,
+    the adjacency structure and therefore the logits are bit-identical
+    to the single-store ``build_serve_step`` over the same unpartitioned
+    array (pinned in tests/test_serving.py): only WHERE the rows live
+    changes, never which rows are read.
+
+    ``exchange_cap`` (``True | int | None``): the compact [H, cap]
+    request block; overflow falls back to the dense [H, F] exchange via
+    the shard-uniform ``lax.pmax``'d ``lax.cond`` inside
+    ``dist_lookup_local`` — row-identical either way, and the whole
+    program still performs zero host syncs (qt-verify's
+    ``no_host_sync`` / ``collective_divergence`` rules cover the traced
+    body; per-variant ``executable_census`` bounds the program count).
+    ``True`` sizes the cap from this variant's frontier capacity.
+
+    ``home`` is THIS replica's partition (the one whose rows its hot
+    tier holds). With ``collect_metrics``, every valid frontier row is
+    classified once (on shard 0 only, so the device-side fold doesn't
+    multiply it by the shard count): owned by ``home`` ->
+    ``locality_hit_rows``, owned elsewhere -> ``locality_miss_rows`` —
+    the router-as-cache-policy payoff counters (miss rows are exactly
+    the rows the exchange must ship in from other partitions)."""
+    from .comm import default_exchange_cap, dist_lookup_local
+    from ._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sizes = list(sizes)
+    h_count = mesh.shape[axis]
+    if exchange_cap is True:
+        from .pyg.sage_sampler import layer_shapes
+        frontier = layer_shapes(batch_cap, sizes)[-1].n_id_cap
+        exchange_cap = default_exchange_cap(frontier, h_count)
+    elif exchange_cap is not None:
+        exchange_cap = int(exchange_cap)
+
+    @hot_path
+    def per_shard(params, key, feat, g2h, g2l, indptr, indices, seeds):
+        from .metrics import (LOCALITY_HIT_ROWS, LOCALITY_MISS_ROWS,
+                              Collector, pmerge_counters)
+        col = Collector() if collect_metrics else None
+        # rep_col: counters of the REPLICATED compute (sampling,
+        # locality classification) — identical on every shard, so they
+        # fold in from shard 0 only; the exchange counters stay
+        # per-shard in ``col`` (each shard really runs an exchange) and
+        # psum to the true mesh-wide totals
+        rep_col = Collector() if collect_metrics else None
+        key, sub = jax.random.split(key)
+        n_id, layers = sample_multihop_serving(
+            indptr, indices, seeds, sizes, sub, method=method,
+            collector=rep_col)
+        x = dist_lookup_local(n_id, g2h, g2l, feat, axis, h_count,
+                              rows_per_host, exchange_cap=exchange_cap,
+                              collector=col)
+        adjs = layers_to_adjs(layers, batch_cap, sizes)
+        with jax.named_scope("qt_serve_forward"):
+            logits = model.apply(params, x, adjs, train=False)
+        if not collect_metrics:
+            return key, logits[:batch_cap]
+        if home is not None:
+            valid = n_id >= 0
+            owner = g2h[jnp.clip(n_id, 0)]
+            rep_col.add(LOCALITY_HIT_ROWS,
+                        jnp.sum(valid & (owner == home)))
+            rep_col.add(LOCALITY_MISS_ROWS,
+                        jnp.sum(valid & (owner != home)))
+        first = jax.lax.axis_index(axis) == 0
+        col.absorb(jnp.where(first, rep_col.counters(), 0))
+        return key, logits[:batch_cap], pmerge_counters(col.counters(),
+                                                        axis)
+
+    outs = (P(), P(), P()) if collect_metrics else (P(), P())
+    raw = shard_map(per_shard, mesh=mesh,
+                    in_specs=(P(), P(), P(axis), P(), P(), P(), P(), P()),
+                    out_specs=outs, check_vma=False)
+    jitted = jax.jit(raw, donate_argnums=(1,))
+    jitted.jitted_fns = (jitted,)
+    jitted.raw = raw
+    return jitted
+
+
+class ShardedServeEngine:
+    """A ``ServeEngine`` whose feature tier is ONE partition-sharded
+    store shared by the whole replica fleet (``DistFeature``) instead of
+    a per-replica copy — the qt-shard path across the single-host
+    memory wall: each replica holds ``~1/P`` of the rows, and frontier
+    rows owned elsewhere arrive through the compact deduplicated
+    exchange INSIDE the jitted serve program.
+
+    ``dist`` must be a ``DistFeature`` built with ``from_partition``
+    (the SPMD mode); ``home`` names this replica's own partition
+    (default ``dist.info.host``) — it scopes the locality hit/miss
+    counters and rides the ``serving`` snapshot so the fleet plane
+    (``qt_top``, the locality router) can see per-replica ownership.
+    The exchange knob comes from ``dist.exchange_cap``; counters honor
+    ``dist.collect_metrics`` semantics but are always folded to the
+    GLOBAL vector on device (``merge_counters`` has no per-shard mode
+    here — a serving replica wants one picture, not H rows).
+
+    Same dispatch contract as ``ServeEngine`` (``run`` is NOT
+    thread-safe; the ``MicroBatchServer`` funnels dispatches through
+    its single pipeline worker), same bounded pre-compiled fanout
+    ladder, and the logits are bit-identical to a single-store
+    ``ServeEngine`` over the unpartitioned array."""
+
+    def __init__(self, model, params, topo, dist,
+                 sizes_variants: Sequence[Sequence[int]],
+                 batch_cap: int,
+                 method: str = "exact",
+                 home: Optional[int] = None,
+                 collect_metrics: bool = False,
+                 seed: int = 0):
+        if not sizes_variants:
+            raise ValueError("need at least one fanout variant")
+        hops = {len(s) for s in sizes_variants}
+        if len(hops) != 1:
+            raise ValueError(
+                f"all fanout variants must share the model's hop count, "
+                f"got lengths {sorted(hops)}")
+        if getattr(dist, "_spmd_feat", None) is None:
+            raise ValueError(
+                "ShardedServeEngine needs a DistFeature built with "
+                "from_partition (the SPMD mode)")
+        if getattr(dist, "_rep_args", None) is not None:
+            raise ValueError(
+                "ShardedServeEngine does not support replicated-tail "
+                "stores yet; partition without replicate=")
+        self.model = model
+        self.params = params
+        self.dist = dist
+        self.variants: List[List[int]] = [list(s) for s in sizes_variants]
+        self.batch_cap = int(batch_cap)
+        self.method = method
+        self.home = int(dist.info.host if home is None else home)
+        self.partitions = int(dist.info.hosts)
+        self.collect_metrics = bool(collect_metrics)
+        self.last_counters = None
+        indptr, indices = (topo.indptr, topo.indices) \
+            if hasattr(topo, "indptr") else topo
+        self._indptr = jnp.asarray(indptr, jnp.int32)
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self._g2h = dist.info.global2host.astype(jnp.int32)
+        self._g2l = dist.info.global2local
+        self._steps = [
+            build_sharded_serve_step(
+                model, sizes, self.batch_cap, dist.comm.mesh,
+                dist.comm.axis, dist._rows_per_host, method=method,
+                exchange_cap=dist.exchange_cap, home=self.home,
+                collect_metrics=self.collect_metrics)
+            for sizes in self.variants]
+        self._key = jax.random.key(seed)
+
+    @property
+    def jitted_fns(self):
+        return tuple(f for s in self._steps for f in s.jitted_fns)
+
+    pad_seeds = ServeEngine.pad_seeds
+
+    def run(self, seeds, variant: int = 0):
+        """Dispatch one ``[batch_cap]`` seed block through the given
+        pre-compiled sharded variant (see ``ServeEngine.run``)."""
+        seeds = np.asarray(seeds, np.int32)
+        if seeds.shape[0] != self.batch_cap:
+            seeds = self.pad_seeds(seeds)
+        out = self._steps[variant](
+            self.params, self._key, self.dist._spmd_feat, self._g2h,
+            self._g2l, self._indptr, self._indices, jnp.asarray(seeds))
+        if self.collect_metrics:
+            self._key, logits, self.last_counters = out
+        else:
+            self._key, logits = out
+        return logits
+
+    def warmup(self):
+        # 4 dispatches per variant, not 1: the donated key buffer's
+        # placement settles over the first few executions (uncommitted
+        # single-device -> mesh-replicated -> steady), each a distinct
+        # jit signature — warming to the steady state keeps serving
+        # recompile-free (pinned by scripts/check_leak.py phase 14)
+        for v in range(len(self.variants)):
+            for _ in range(4):
+                jax.block_until_ready(self.run(
+                    np.zeros((self.batch_cap,), np.int32), v))
+        return self
+
+
 # -- the server: admission, coalescing, shedding, scatter --------------------
 
 
@@ -1139,6 +1345,15 @@ class MicroBatchServer:
             "health": self.health()["score"],
             "knobs": self.knobs(),
         }
+        home = getattr(self.engine, "home", None)
+        if home is not None:
+            # sharded engine: per-replica partition ownership, the
+            # fleet plane's routing/locality pivot (qt_top, the
+            # locality router's ownership column)
+            rec["serving"]["partition"] = {
+                "home": int(home),
+                "partitions": int(getattr(self.engine, "partitions", 1)),
+            }
         return rec
 
     def emit(self, sink, kind: str = "serving") -> dict:

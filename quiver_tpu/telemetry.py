@@ -77,7 +77,8 @@ DETECTOR_NAMES = ("mean_shift", "page_hinkley", "spike")
 #: advice record keys :meth:`TelemetryHub.replan` can emit (same lint
 #: contract as ``DETECTOR_NAMES``)
 ADVICE_KEYS = ("hot_capacity", "exchange_cap", "dedup_budget",
-               "batch_cap", "max_wait_ms", "io_workers")
+               "batch_cap", "max_wait_ms", "io_workers",
+               "partitions", "locality_weight")
 
 
 # -- the per-metric ring time-series ----------------------------------------
@@ -318,6 +319,10 @@ class PlanContext:
       deployment (``Feature.enable_cold_prefetch``) — how many staging
       workers shard each publication, and the reader pool's queue
       depth (the ceiling any worker recommendation respects).
+    - ``partitions`` / ``locality_weight``: the sharded-serving fleet
+      shape (how many partition homes the store is split across) and
+      the ``HealthRouter.set_locality`` blend weight the fleet routes
+      with (qt-shard).
     - ``slack``: the proportional headroom every recommendation carries
       (the planners' own default 1.25).
     """
@@ -335,6 +340,8 @@ class PlanContext:
                  target_p99_ms: Optional[float] = None,
                  io_workers: Optional[int] = None,
                  io_qd: Optional[int] = None,
+                 partitions: Optional[int] = None,
+                 locality_weight: Optional[float] = None,
                  slack: float = 1.25):
         self.hot_capacity = hot_capacity
         self.total_rows = total_rows
@@ -350,6 +357,8 @@ class PlanContext:
         self.target_p99_ms = target_p99_ms
         self.io_workers = io_workers
         self.io_qd = io_qd
+        self.partitions = partitions
+        self.locality_weight = locality_weight
         self.slack = float(slack)
 
 
@@ -797,7 +806,9 @@ class TelemetryHub:
             for fn in (self._advise_hot_capacity,
                        self._advise_exchange_cap,
                        self._advise_dedup_budget, self._advise_batch_cap,
-                       self._advise_max_wait, self._advise_io_workers):
+                       self._advise_max_wait, self._advise_io_workers,
+                       self._advise_partitions,
+                       self._advise_locality_weight):
                 rec = fn(plan)
                 if rec is not None:
                     out.append(rec)
@@ -1014,6 +1025,84 @@ class TelemetryHub:
                        f"IO-bound at {cur} worker(s); "
                        f"{rec} shards the unique-row set wider "
                        f"(<= io_qd={cap})"),
+        }
+
+    def _advise_partitions(self, plan: PlanContext) -> Optional[dict]:
+        """Size the sharded-serving fleet from the same degree-mass
+        inversion the hot-capacity advisor uses: the rows needed to
+        reach the planned hit rate, divided by what ONE partition's hot
+        tier holds, is how many partition homes the fleet needs so that
+        locality routing CAN reach the target at all (no router blend
+        fixes a fleet whose combined hot tiers don't cover the mass).
+        Gated on the observed ``locality_hit_rate`` series actually
+        falling short — a fleet already hitting the target is left
+        alone."""
+        if (plan.partitions is None or plan.hot_capacity is None
+                or plan.expected_hit_rate is None
+                or plan.degree is None):
+            return None
+        obs = self._stats("locality_hit_rate")
+        if obs is None:
+            return None
+        observed, target = obs["mean"], float(plan.expected_hit_rate)
+        if observed >= target - 0.05:
+            return None
+        need = rows_for_hit_rate(plan.degree, target)
+        rec = max(1, int(math.ceil(need / max(int(plan.hot_capacity),
+                                              1))))
+        if rec <= int(plan.partitions):
+            return None
+        return {
+            "key": "partitions",
+            "current": int(plan.partitions),
+            "recommended": int(rec),
+            "observed": {"locality_hit_rate": round(observed, 4),
+                         "expected_hit_rate": round(target, 4),
+                         "rows_needed": int(need)},
+            "reason": (f"observed locality hit rate {observed:.2f} vs "
+                       f"planned {target:.2f}; {need} hot rows reach "
+                       f"the target, needing {rec} partition hot "
+                       f"tier(s) of {int(plan.hot_capacity)}"),
+        }
+
+    def _advise_locality_weight(self,
+                                plan: PlanContext) -> Optional[dict]:
+        """Tune the router's health/locality blend from the observed
+        ``locality_hit_rate``: misses mean frontier rows ship through
+        the exchange, so a short hit rate advises leaning HARDER on
+        locality (up to 0.9 — health keeps its veto); a saturated one
+        (>= 0.98) advises relaxing toward 0.5 so health can rebalance
+        load again (pure locality pins the hottest partition's owner
+        even while it sheds)."""
+        if plan.locality_weight is None:
+            return None
+        obs = self._stats("locality_hit_rate")
+        if obs is None:
+            return None
+        w = float(plan.locality_weight)
+        observed = obs["mean"]
+        target = float(plan.expected_hit_rate
+                       if plan.expected_hit_rate is not None else 0.8)
+        if observed < target - 0.05 and w < 0.9:
+            rec = min(0.9, round(w + 0.25, 2))
+            why = (f"locality hit rate {observed:.2f} short of "
+                   f"{target:.2f}: mis-routed frontier rows pay the "
+                   "exchange; lean harder on locality")
+        elif observed >= 0.98 and w > 0.5:
+            rec = max(0.5, round(w / 2, 2))
+            why = (f"locality hit rate saturated at {observed:.2f}: "
+                   "relax the blend so health can rebalance load")
+        else:
+            return None
+        if abs(rec - w) < 1e-9:
+            return None
+        return {
+            "key": "locality_weight",
+            "current": w,
+            "recommended": rec,
+            "observed": {"locality_hit_rate": round(observed, 4),
+                         "target": round(target, 4)},
+            "reason": why,
         }
 
     # -- rendering -----------------------------------------------------------

@@ -182,6 +182,14 @@ def is_skipped(rec):
 #: means the rotation loop stopped winning), and its served p99
 #: (LOWER-is-better: actuation that buys hit rate by flapping knobs
 #: into latency is a regression, not a win).
+#: ``sharded_agg_rps`` / ``sharded_p99_ms`` / ``locality_hit_rate``
+#: (qt-shard's serving pass over the partition-sharded store, from
+#: ``bench.py``) join in round 20: aggregate seeds/sec through the
+#: jitted shard_map serve step (higher is better), its per-batch
+#: dispatch p99 (LOWER-is-better), and the observed fraction of the
+#: frontier resident in the home partition's tier under
+#: locality-routed arrivals — losing it means the exchange is
+#: shipping rows the router was supposed to keep home.
 SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
                "cold_staged_rows_per_s", "gather_efficiency",
                "chaos_accepted_p99_ratio", "chaos_error_rate",
@@ -189,7 +197,9 @@ SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
                "tail_rps_ratio", "tail_kept_frac",
                "fused_vs_split_steps_per_s",
                "fused_gather_index_bytes",
-               "adaptive_hit_rate", "adaptive_served_p99_ms")
+               "adaptive_hit_rate", "adaptive_served_p99_ms",
+               "sharded_agg_rps", "sharded_p99_ms",
+               "locality_hit_rate")
 
 #: trajectory groups where LOWER is better: "best prior" is the
 #: minimum, and the regression rule inverts — the latest value more
@@ -198,7 +208,7 @@ SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
 INVERTED_METRICS = ("chaos_accepted_p99_ratio", "chaos_error_rate",
                     "chaos_detection_s", "chaos_recovery_s",
                     "tail_kept_frac", "fused_gather_index_bytes",
-                    "adaptive_served_p99_ms")
+                    "adaptive_served_p99_ms", "sharded_p99_ms")
 
 #: per-metric absolute slack for the inverted rule: several of these
 #: bottom out at 0.0 (a chaos run with EVERY request recovered records
@@ -216,7 +226,8 @@ INVERTED_ABS_SLACK = {"chaos_error_rate": 0.02,
                       "tail_kept_frac": 0.05,
                       # a CPU-box p99 wobbles by a few ms between
                       # otherwise-identical serving runs
-                      "adaptive_served_p99_ms": 5.0}
+                      "adaptive_served_p99_ms": 5.0,
+                      "sharded_p99_ms": 5.0}
 
 
 def _points(rec):

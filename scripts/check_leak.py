@@ -115,6 +115,17 @@ bit-identical to the control (for the quantized tiers that is the FMA
 decode convention doing its job as rows cross tiers), and live arrays
 flat.
 
+Phase 14 pins SHARDED SERVING (qt-shard): 50 serves through a
+``ShardedServeEngine`` over a 2-partition ``DistFeature`` store,
+alternating duplicate-heavy batches (the compact narrow exchange) with
+unique-heavy ones that overflow the per-shard unique table (the
+pmax'd dense ``lax.cond`` fallback) — both branches live in the ONE
+warmed shard_map program, so the executable cache must not grow no
+matter which branch a batch takes, and every batch's logits are
+bit-compared against an UNSHARDED single-store engine replaying the
+identical seed sequence (same PRNG chain): partitioning changes where
+rows live, never what the model computes.
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -1133,6 +1144,105 @@ def main():
     print("no leak detected (phase 13: 50 metered steps across 3 "
           "actuated knob swaps + 2 hot-set rotations, rows "
           "bit-identical to the unactuated replay)")
+
+    # ---- phase 14: sharded serving — narrow/fallback alternation, ----
+    # ---- bit-identical to the unsharded replay ----
+    # The qt-shard correctness contract, measured: the serve step over
+    # the partitioned store is the SAME computation as the single-store
+    # engine (only row placement differs), and its one warmed program
+    # holds both the compact narrow exchange and the dense fallback.
+    from quiver_tpu import metrics as qmetrics
+    from quiver_tpu.serving import ServeEngine, ShardedServeEngine
+
+    sh_hosts, sh_cap, sh_bs = 2, 40, 16
+    sh_mesh = Mesh(np.array(jax.devices()[:sh_hosts]),
+                   axis_names=("host",))
+    sh_g2h = (np.arange(dn) % sh_hosts).astype(np.int32)
+    sh_info = qv.PartitionInfo(host=0, hosts=sh_hosts,
+                               global2host=sh_g2h)
+    sh_comm = qv.TpuComm(rank=0, world_size=sh_hosts, mesh=sh_mesh,
+                         axis="host")
+    sh_dist = qv.DistFeature.from_partition(dfeat, sh_info, sh_comm,
+                                            exchange_cap=sh_cap,
+                                            collect_metrics=True)
+    # the dist-trained params/topology are replicated over the FULL
+    # 8-device mesh; re-materialize uncommitted host copies so the
+    # 2-device sub-mesh program can place them itself
+    sh_params = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a)), dstate.params)
+    sh_indptr = jnp.asarray(np.asarray(dindptr_j))
+    sh_indices = jnp.asarray(np.asarray(dindices_j))
+    sharded_eng = ShardedServeEngine(
+        dmodel, sh_params, (sh_indptr, sh_indices), sh_dist,
+        sizes_variants=[dsizes], batch_cap=sh_bs,
+        collect_metrics=True, seed=5)
+    control_eng = ServeEngine(
+        dmodel, sh_params, (sh_indptr, sh_indices),
+        jnp.asarray(dfeat), sizes_variants=[dsizes], batch_cap=sh_bs,
+        seed=5)
+
+    def sh_batch(i):
+        # even i: duplicate-heavy — <=4 distinct seeds, so the whole
+        # frontier has <=40 uniques: <= the per-owner cap (40) AND the
+        # unique budget (min(cap*2, 192)=80) — the narrow branch by
+        # construction. odd i: 16 distinct seeds, whose 2-hop frontier
+        # exceeds the 80-unique budget — the dense fallback (pinned at
+        # runtime via the per-batch counters below).
+        if i % 2 == 0:
+            pool = rng.integers(0, dn, 4)
+            return pool[rng.integers(0, 4, sh_bs)].astype(np.int32)
+        return rng.choice(dn, sh_bs, replace=False).astype(np.int32)
+
+    # warmup: compile both programs, advancing BOTH key chains in
+    # lockstep on the same seeds (same engine seed -> same chain, so
+    # every later batch stays bit-comparable). FOUR dispatches, not
+    # one: the sharded step's donated key buffer settles its placement
+    # (uncommitted -> mesh-replicated -> steady) over the first few
+    # executions, each a distinct jit signature — the leak gate below
+    # measures the steady state, same as ShardedServeEngine.warmup()
+    for w in range(4):
+        wb = sh_batch(w)
+        jax.block_until_ready(sharded_eng.run(wb))
+        jax.block_until_ready(control_eng.run(wb))
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    sh_fns = list(sharded_eng.jitted_fns) + list(control_eng.jitted_fns)
+    base_cache = sum(f._cache_size() for f in sh_fns)
+
+    narrow = fallback = 0
+    for i in range(50):
+        ids = sh_batch(i)
+        got = sharded_eng.run(ids)
+        want = control_eng.run(ids)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg="sharded logits diverged from the unsharded replay")
+        c = np.asarray(sharded_eng.last_counters)
+        assert c[qmetrics.EXCH_CALLS] > 0
+        if i % 2 == 0:
+            assert c[qmetrics.EXCH_FALLBACK] == 0, \
+                "phase premise: duplicate-heavy batch must stay narrow"
+            narrow += 1
+        else:
+            assert c[qmetrics.EXCH_FALLBACK] > 0, \
+                "phase premise: unique-heavy batch must trip the " \
+                "dense fallback"
+            fallback += 1
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = sum(f._cache_size() for f in sh_fns) - base_cache
+    print(f"phase 14 live arrays: {base_arrays} -> {arrays}; "
+          f"sharded-serve executable-cache growth: {grew}; "
+          f"batches: {narrow} narrow / {fallback} fallback")
+    assert narrow == 25 and fallback == 25
+    # both cond branches live in the ONE warmed shard_map executable
+    assert grew == 0, \
+        "sharded serving recompiled mid-loop (branch/shape leak)"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak across sharded serves"
+    print("no leak detected (phase 14: 50 sharded serves alternating "
+          "narrow exchange and dense fallback, logits bit-identical "
+          "to the unsharded replay)")
 
 
 if __name__ == "__main__":

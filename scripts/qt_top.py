@@ -215,13 +215,19 @@ def render_fleet(fleet, series, width, c):
         spark = sparkline(trend, width) if trend else ""
         comp = r.get("components") or {}
         burn = comp.get("burn")
+        part = r.get("partition") or {}
+        owns = (f"  part {part.get('home')}/{part.get('partitions')}"
+                if _num(part.get("home")) else "")
+        loc = r.get("locality_hit_rate")
+        loc_s = f"  loc {loc:.2f}" if _num(loc) else ""
         lines.append(c(tint, (
             f"  {name:<{name_w}}  {spark:<{width}}  health "
             f"{h if _num(h) else '?'}"
             f"{'  STALE' if stale else ''}  "
             f"age {r.get('age_s', '?')}s  "
             f"burn {burn if _num(burn) else 'n/a'}  "
-            f"shed {comp.get('shed_frac', 0)}")))
+            f"shed {comp.get('shed_frac', 0)}"
+            f"{owns}{loc_s}")))
     return lines
 
 

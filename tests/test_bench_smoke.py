@@ -89,6 +89,15 @@ def test_bench_cpu_smoke_json_contract(tmp_path):
     assert 0.0 < out["gather_efficiency"] <= 2.0
     assert out["gather_achieved_gbps"] > 0
     assert out["probe_gather_gbps"] > 0
+    # qt-shard: the sharded-serve pass over the 2-partition store ran
+    # on the forced 2-device host mesh — aggregate throughput, batch
+    # dispatch p99 (both bench_regress trajectory groups, the p99
+    # inverted) and the OBSERVED locality hit rate: home-skewed
+    # arrivals with ~10% strays over a ~90%-intra-partition graph,
+    # so the rate must land strictly inside (0, 1)
+    assert out["sharded_agg_rps"] > 0
+    assert out["sharded_p99_ms"] > 0
+    assert 0.0 < out["locality_hit_rate"] < 1.0
     assert set(out["stage_ms"]) == {"sample", "gather", "cold_tier"}
     assert all(v > 0 for v in out["stage_ms"].values())
     assert sum(out["stage_shares"].values()) == pytest.approx(1.0,
@@ -224,6 +233,64 @@ def test_bench_serving_smoke_json_contract(tmp_path):
     with open(sink_path) as f:
         recs = [json.loads(l) for l in f if l.strip()]
     recs = [r for r in recs if r["kind"] != "meta"]    # sink header
+    assert len(recs) == 1
+    assert recs[0]["kind"] == "bench"
+    assert recs[0]["value"] == out["value"]
+
+
+@pytest.mark.slow  # full sharded fleet build x3 partition counts, ~3 min
+def test_bench_sharded_smoke_json_contract(tmp_path):
+    """The qt-shard payoff bench (benchmarks/bench_sharded.py) keeps
+    its JSON contract tested at smoke scale: the P=1/2/4 partition
+    sweep with per-P bit-identity probes, and the locality-vs-
+    health-only A/B where the honest in-process payoff is EXCHANGE
+    BYTES per request (both arms premise-asserted onto the same
+    fallback-free narrow program, so wall clock is parity — the bytes
+    are what a real multi-host wire turns into latency)."""
+    sink_path = str(tmp_path / "metrics.jsonl")
+    env = dict(os.environ)
+    env.update({
+        "QT_METRICS_JSONL": sink_path,
+        "JAX_PLATFORMS": "cpu",
+        "QT_SHARD_SMOKE": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_sharded.py")],
+        env=env, capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout          # ONE JSON line
+    out = json.loads(lines[0])
+    assert "skipped" not in out and "error" not in out
+    assert out["unit"] == "requests/s"
+    assert out["value"] and out["value"] > 0
+    assert out["bit_identical"] is True
+    # the partition sweep ran every count; P=1 is locality-trivial
+    assert set(out["partitions"]) == {"1", "2", "4"}
+    for p, row in out["partitions"].items():
+        assert row["agg_rps"] > 0 and row["p99_ms"] > 0
+    assert out["partitions"]["1"]["locality_hit_rate"] == 1.0
+    # ...and the probe logits were identical across partition counts
+    checksums = {row["probe_checksum"]
+                 for row in out["partitions"].values()}
+    assert len(checksums) == 1
+    # the A/B: same fixed-shape narrow program in both arms (the
+    # concentration-sized exchange_cap premise), strictly fewer
+    # exchange bytes per request and a strictly higher hit rate
+    # under locality routing
+    ab = out["ab"]
+    loc, health = ab["locality"], ab["health_only"]
+    assert loc["fallback_batches"] == 0
+    assert health["fallback_batches"] == 0
+    assert loc["exch_bytes_per_req"] < health["exch_bytes_per_req"]
+    assert loc["locality_hit_rate"] > health["locality_hit_rate"]
+    assert ab["rps_ratio"] > 0
+    assert isinstance(ab["locality_ge_health_rps"], bool)
+    # mirrored into the structured metrics log with the shared schema
+    with open(sink_path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    recs = [r for r in recs if r["kind"] != "meta"]
     assert len(recs) == 1
     assert recs[0]["kind"] == "bench"
     assert recs[0]["value"] == out["value"]

@@ -527,6 +527,111 @@ class TestPartitioner:
         assert (book[res[1]] == 1).all()
 
 
+class TestPartitionInfoArtifacts:
+    """qt-shard: PartitionInfo save/load round-trip + the degree-mass
+    locality table serving replicas rebuild from disk without
+    re-partitioning."""
+
+    def _info(self, rng, n=64, hosts=4):
+        from quiver_tpu.partition import save_partition_info
+        g2h = rng.integers(0, hosts, n).astype(np.int32)
+        g2h[:hosts] = np.arange(hosts)
+        return qv.PartitionInfo(host=1, hosts=hosts, global2host=g2h)
+
+    def test_save_load_roundtrip(self, rng, tmp_path):
+        from quiver_tpu.partition import (load_partition_info,
+                                          save_partition_info)
+        info = self._info(rng)
+        path = str(tmp_path / "pinfo")
+        meta = save_partition_info(info, path)
+        assert meta["kind"] == "partition_info"
+        back = load_partition_info(path)
+        assert back.host == info.host and back.hosts == info.hosts
+        np.testing.assert_array_equal(np.asarray(back.global2host),
+                                      np.asarray(info.global2host))
+        assert back.replicate is None
+        # each replica names its own slot from the SHARED artifact
+        assert load_partition_info(path, host=3).host == 3
+        # second save refuses silent clobber, overwrite allows it
+        with pytest.raises(FileExistsError):
+            save_partition_info(info, path)
+        save_partition_info(info, path, overwrite=True)
+
+    def test_roundtrip_with_replicate(self, rng, tmp_path):
+        from quiver_tpu.partition import (load_partition_info,
+                                          save_partition_info)
+        g2h = rng.integers(0, 2, 32).astype(np.int32)
+        g2h[:2] = [0, 1]
+        info = qv.PartitionInfo(host=0, hosts=2, global2host=g2h,
+                                replicate=np.array([3, 7], np.int32))
+        path = str(tmp_path / "rep")
+        save_partition_info(info, path)
+        back = load_partition_info(path)
+        np.testing.assert_array_equal(np.asarray(back.replicate),
+                                      [3, 7])
+
+    def test_load_refuses_mismatched_meta(self, rng, tmp_path):
+        import json
+        from quiver_tpu.partition import (load_partition_info,
+                                          save_partition_info)
+        info = self._info(rng)
+        path = str(tmp_path / "bad")
+        save_partition_info(info, path)
+        meta_path = tmp_path / "bad" / "partition_info.json"
+        meta = json.loads(meta_path.read_text())
+        meta["nodes"] = 999
+        meta_path.write_text(json.dumps(meta))
+        with pytest.raises(ValueError, match="refusing to mis-decode"):
+            load_partition_info(path)
+        meta["nodes"] = 64
+        meta["hosts"] = 2            # g2h names host 3
+        meta_path.write_text(json.dumps(meta))
+        with pytest.raises(ValueError, match="refusing"):
+            load_partition_info(path)
+        meta["kind"] = "disk_tier"
+        meta_path.write_text(json.dumps(meta))
+        with pytest.raises(ValueError, match="artifact"):
+            load_partition_info(path)
+
+    def test_partition_hot_mask_is_per_partition_top_degree(self):
+        from quiver_tpu.partition import partition_hot_mask
+        g2h = np.array([0, 0, 0, 1, 1, 1], np.int32)
+        deg = np.array([5, 9, 1, 2, 8, 8], np.float64)
+        hot = partition_hot_mask(g2h, 1, deg)
+        # per-partition argmax; ties resolve to the FIRST (stable sort)
+        np.testing.assert_array_equal(
+            hot, [False, True, False, False, True, False])
+        hot2 = partition_hot_mask(g2h, [2, 1], deg)
+        np.testing.assert_array_equal(
+            hot2, [True, True, False, False, True, False])
+
+    def test_locality_table_degree_mass(self):
+        from quiver_tpu.partition import build_locality_table
+        # node 0 -> {1, 2}; node 1 -> {0}; node 2 -> {}  (3 nodes)
+        indptr = np.array([0, 2, 3, 3], np.int64)
+        indices = np.array([1, 2, 0], np.int32)
+        g2h = np.array([0, 1, 1], np.int32)
+        # every row hot: pure ownership mass
+        t = build_locality_table(indptr, indices, g2h, 3,
+                                 include_self=False)
+        assert t.shape == (3, 2)
+        # node 0's frontier: node 1 (deg 1, mass 2) + node 2 (mass 1),
+        # both partition 1
+        np.testing.assert_allclose(t[0], [0.0, 1.0], atol=1e-6)
+        np.testing.assert_allclose(t[1], [1.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(t[2], [0.0, 0.0], atol=1e-6)
+        # include_self folds the seed's own row into its mass
+        ts = build_locality_table(indptr, indices, g2h, 3,
+                                  include_self=True)
+        # node 0 self-mass 3 (deg 2 + 1) in partition 0, frontier 3 in 1
+        np.testing.assert_allclose(ts[0], [0.5, 0.5], atol=1e-6)
+        # rows sum to <= 1, and to 1 when everything is hot
+        assert np.all(ts.sum(1) <= 1.0 + 1e-6)
+        # cold rows are nobody's win: zero hot rows -> zero table
+        t0 = build_locality_table(indptr, indices, g2h, 0)
+        np.testing.assert_allclose(t0, 0.0)
+
+
 class TestOffloadHostTier:
     """host_placement="offload": the fused one-dispatch tiered lookup.
     Placement itself is TPU/GPU-only (CPU backend gated out, loud

@@ -875,6 +875,68 @@ class TestHealthRouter:
             qf.HealthRouter(drain_below=0.8, readmit_above=0.5)
 
 
+class TestLocalityRouter:
+    """qt-shard: the partition-aware blend — locality is a cache
+    policy the router applies, health keeps its veto."""
+
+    def _router(self, weight=0.8):
+        import numpy as np
+        r = qf.HealthRouter(["a", "b"], seed=3)
+        r.update("a", 1.0)
+        r.update("b", 1.0)
+        # seed 0's frontier mass lives in partition 0, seed 1's in 1
+        table = np.array([[0.9, 0.1], [0.1, 0.9]], np.float32)
+        r.set_locality(table, {"a": 0, "b": 1}, weight=weight)
+        return r
+
+    def test_seeded_pick_prefers_owner(self):
+        r = self._router()
+        n = 400
+        a0 = sum(r.pick(seed=0) == "a" for _ in range(n))
+        b1 = sum(r.pick(seed=1) == "b" for _ in range(n))
+        # effective weights 0.92 vs 0.28: owner share ~0.77
+        assert a0 / n > 0.6 and b1 / n > 0.6
+
+    def test_ranked_orders_by_blend(self):
+        r = self._router()
+        assert r.ranked(seed=0) == ["a", "b"]
+        assert r.ranked(seed=1) == ["b", "a"]
+
+    def test_no_seed_and_unknown_seed_stay_health_only(self):
+        r = self._router()
+        r.update("a", 0.9)
+        r.update("b", 0.8)
+        assert r.ranked() == ["a", "b"]          # pure health
+        assert r.ranked(seed=10 ** 9) == ["a", "b"]  # out of table
+        # replica missing from owners: NEUTRAL factor (never penalized
+        # for what the router doesn't know) — eff: c 0.95, b
+        # 0.8*(0.2 + 0.8*0.9) = 0.736, a 0.9*(0.2 + 0.8*0.1) = 0.252
+        r.update("c", 0.95)
+        assert r.ranked(seed=1) == ["c", "b", "a"]
+
+    def test_health_keeps_its_veto(self):
+        r = self._router()
+        r.update("a", 0.05)                      # drained
+        # even seed 0 (partition 0's own traffic) routes to b first
+        assert r.ranked(seed=0) == ["b", "a"]
+        picks = {r.pick(seed=0) for _ in range(32)}
+        assert picks == {"b"}
+
+    def test_weight_validation_and_snapshot(self):
+        import numpy as np
+        r = self._router(weight=0.6)
+        snap = r.snapshot()
+        assert snap["locality"] == {"weight": 0.6,
+                                    "owners": {"a": 0, "b": 1}}
+        with pytest.raises(ValueError, match="weight"):
+            r.set_locality(np.eye(2), {}, weight=1.0)
+        with pytest.raises(ValueError, match="table"):
+            r.set_locality(np.zeros(3), {}, weight=0.5)
+        # disarm: weight 0 drops the snapshot block and the blend
+        r.set_locality(None, {}, weight=0.0)
+        assert "locality" not in r.snapshot()
+
+
 # ---------------------------------------------------------------------------
 # 11. replica supervision (fake clock + fake processes: deterministic)
 # ---------------------------------------------------------------------------

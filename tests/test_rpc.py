@@ -686,3 +686,138 @@ class TestScaleDownZeroLoss:
         assert not [r for r in events if r.get("kind") == "chaos"
                     and r.get("replica") == "r2"
                     and r.get("event") in ("exit", "restart")]
+
+
+# ---------------------------------------------------------------------------
+# qt-shard chaos gate: SIGKILL the replica that OWNS a partition
+# ---------------------------------------------------------------------------
+
+
+class TestPartitionOwnerKill:
+    """The sharded fleet's degraded-but-correct story: locality routing
+    concentrates a partition's traffic on its owner, the owner dies
+    under sustained load, and every one of its requests still resolves
+    — non-owners serve any node (the dense/exchange fallback the real
+    sharded engine proves bit-identical in test_serving.py), the
+    router's health veto overrides locality while the owner is down,
+    and locality routing resumes on re-admit."""
+
+    def test_owner_kill_zero_lost_then_locality_resumes(self, tmp_path):
+        names = ["r0", "r1", "r2"]
+        ports = dict(zip(names, free_ports(3)))
+        sinks = {n: str(tmp_path / f"{n}.jsonl") for n in names}
+        ev_sink = qm.MetricsSink(str(tmp_path / "events.jsonl"))
+        plan = qv.FaultPlan(seed=7, rules={
+            "rpc.request": qv.FaultRule("kill", after=KILL_AFTER)})
+
+        def spawn(name, index, attempt):
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("QT_FAULTS", "QT_FAULTS_SEED")}
+            if name == "r0" and attempt == 0:
+                # the kill arms only the OWNER's first life — and under
+                # locality routing the owner sees its partition's
+                # traffic, so the seeded request-count trigger fires
+                # mid-load on exactly the partition-0 stream
+                env.update(plan.env())
+            return subprocess.Popen(
+                [sys.executable, "-c", _REPLICA, REPO, name,
+                 str(ports[name]), sinks[name]],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+
+        sup = qf.ReplicaSupervisor(
+            spawn, 3, names=names, backoff_s=1.2, backoff_cap_s=2.4,
+            monitor_interval_s=0.05, healthy_uptime_s=5.0,
+            sink=ev_sink).start()
+        agg = qf.FleetAggregator(sinks, interval_s=0.2,
+                                 stale_after_s=0.4, sink=ev_sink)
+        router = qf.HealthRouter(names, seed=3)
+        # replica rI owns partition I; node v's frontier mass lives in
+        # partition v % 3 (the degree-mass table a real deployment
+        # precomputes via partition.build_locality_table)
+        nodes = 50
+        table = np.full((nodes, 3), 0.05, np.float32)
+        table[np.arange(nodes), np.arange(nodes) % 3] = 0.9
+        router.set_locality(table, {"r0": 0, "r1": 1, "r2": 2},
+                            weight=0.8)
+        agg.on_poll.append(router.sync)
+        cli = qrpc.RpcClient(
+            {n: ("127.0.0.1", p) for n, p in ports.items()},
+            router=router, timeout_ms=400.0, retries=3,
+            backoff_ms=20.0, backoff_cap_ms=150.0,
+            hedge=True, hedge_delay_ms=60.0, seed=5)
+        try:
+            deadline = time.monotonic() + 20.0
+            up = set()
+            while time.monotonic() < deadline and len(up) < 3:
+                for n in names:
+                    if n not in up:
+                        try:
+                            if cli.ping(n, timeout_ms=300)["ok"]:
+                                up.add(n)
+                        except Exception:
+                            pass
+                time.sleep(0.05)
+            assert up == set(names), f"fleet never came up: {up}"
+            agg.start()
+
+            # sustained open-loop load over every partition; ~1/3 of it
+            # concentrates on r0, whose 35th request kills it
+            futs = []
+            t0 = time.perf_counter()
+            for k in range(240):
+                target = t0 + k * 0.018
+                delay = target - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                futs.append((k, cli.lookup_future(k % nodes,
+                                                  budget_ms=8000.0)))
+
+            # THE gate: zero requests lost to the owner kill —
+            # partition-0 traffic rides the fallback to non-owners
+            failed = []
+            for k, fut in futs:
+                try:
+                    row = fut.result(timeout=60)
+                    np.testing.assert_array_equal(
+                        row, fake_row(k % nodes))
+                except qrpc.RpcError as e:
+                    failed.append((k, type(e).__name__))
+            assert not failed, f"requests lost to owner kill: {failed}"
+
+            # the owner died (the plan fired) and was restarted
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                st = sup.status()
+                if st["r0"]["alive"] and st["r0"]["restarts"] >= 1:
+                    break
+                time.sleep(0.1)
+            st = sup.status()
+            assert st["r0"]["restarts"] >= 1, st
+            assert st["r1"]["restarts"] == 0 and \
+                st["r2"]["restarts"] == 0
+
+            # health veto while down: the router drained the owner
+            # (locality must NOT pin dead-owner traffic)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and \
+                    "r0" in router.snapshot()["drained"]:
+                time.sleep(0.1)
+            rsnap = router.snapshot()
+            assert rsnap["drains"] >= 1, rsnap
+            assert "r0" not in rsnap["drained"], rsnap
+            assert rsnap["locality"]["owners"]["r0"] == 0
+
+            # locality routing RESUMES on the re-admitted owner: a
+            # partition-0 seed ranks its owner first again
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and \
+                    router.ranked(seed=0)[0] != "r0":
+                time.sleep(0.1)
+            assert router.ranked(seed=0)[0] == "r0"
+            assert router.ranked(seed=1)[0] == "r1"
+        finally:
+            cli.close()
+            agg.close()
+            sup.close()
+            ev_sink.close()

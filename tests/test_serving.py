@@ -551,3 +551,121 @@ class TestLifecycle:
         s = srv.snapshot()["serving"]
         assert s["failed"] >= 1 and s["completed"] >= 1
         srv.close()
+
+
+class TestShardedServe:
+    """qt-shard: the serve step over a DistFeature-partitioned store.
+    The load-bearing pin: logits bit-identical to the single-store
+    engine across the dense, narrow-exchange AND forced-fallback
+    paths — partitioning changes WHERE rows live, never which rows the
+    model sees."""
+
+    HOSTS = 2
+
+    def _dist(self, feat, exchange_cap, collect=True, rng_seed=3):
+        from jax.sharding import Mesh
+        rng = np.random.default_rng(rng_seed)
+        g2h = rng.integers(0, self.HOSTS, N).astype(np.int32)
+        g2h[:self.HOSTS] = np.arange(self.HOSTS)
+        mesh = Mesh(np.array(jax.devices()[:self.HOSTS]), ("host",))
+        info = qv.PartitionInfo(host=0, hosts=self.HOSTS,
+                                global2host=g2h)
+        comm = qv.TpuComm(rank=0, world_size=self.HOSTS, mesh=mesh,
+                          axis="host")
+        return qv.DistFeature.from_partition(
+            feat, info, comm, exchange_cap=exchange_cap,
+            collect_metrics=collect)
+
+    def _engines(self, world, exchange_cap, collect=True):
+        model, params, ij, xj, feat = world
+        dist = self._dist(feat, exchange_cap, collect=collect)
+        sharded = qv.ShardedServeEngine(
+            model, params, (ij, xj), dist,
+            sizes_variants=[FULL, SHED], batch_cap=CAP,
+            collect_metrics=collect, seed=9)
+        single = qv.ServeEngine(model, params, (ij, xj), feat,
+                                sizes_variants=[FULL, SHED],
+                                batch_cap=CAP,
+                                collect_metrics=collect, seed=9)
+        return sharded, single
+
+    @pytest.mark.parametrize("cap,expect_fallback", [
+        (None, None),   # dense exchange (no compact path at all)
+        (32, False),    # narrow: compact path must stay compact
+        (2, True),      # forced fallback on every batch
+    ])
+    def test_bit_identical_to_single_store(self, world, cap,
+                                           expect_fallback):
+        sharded, single = self._engines(world, cap)
+        rng = np.random.default_rng(5)
+        saw_fallback = 0
+        for i in range(4):
+            if i % 2 == 0:     # dup-heavy: few uniques, deep dedup
+                seeds = rng.integers(0, 6, CAP).astype(np.int32)
+            else:              # unique-heavy: wide frontier
+                seeds = rng.choice(N, CAP, replace=False).astype(
+                    np.int32)
+            variant = i % 2    # both ladder rungs
+            got = np.asarray(sharded.run(seeds, variant=variant))
+            want = np.asarray(single.run(seeds, variant=variant))
+            np.testing.assert_array_equal(got, want)
+            if cap is not None:
+                c = np.asarray(sharded.last_counters)
+                saw_fallback += int(c[qm.EXCH_FALLBACK] > 0)
+        if expect_fallback is True:
+            assert saw_fallback == 4     # cap 2 can never fit
+        elif expect_fallback is False:
+            assert saw_fallback == 0     # cap 32 never overflows here
+
+    def test_zero_host_syncs_in_sharded_step(self, world):
+        model, params, ij, xj, feat = world
+        for collect in (False, True):
+            dist = self._dist(feat, 32, collect=collect)
+            eng = qv.ShardedServeEngine(model, params, (ij, xj), dist,
+                                        sizes_variants=[FULL],
+                                        batch_cap=CAP,
+                                        collect_metrics=collect)
+            args = (eng.params, jax.random.key(0), dist._spmd_feat,
+                    eng._g2h, eng._g2l, eng._indptr, eng._indices,
+                    jnp.zeros((CAP,), jnp.int32))
+            assert host_sync_eqns(eng._steps[0].raw, args) == []
+
+    def test_locality_counters_classify_every_frontier_row(self, world):
+        sharded, _ = self._engines(world, 32)
+        rng = np.random.default_rng(11)
+        seeds = rng.choice(N, CAP, replace=False).astype(np.int32)
+        sharded.run(seeds)
+        c = np.asarray(sharded.last_counters)
+        hit = int(c[qm.LOCALITY_HIT_ROWS])
+        miss = int(c[qm.LOCALITY_MISS_ROWS])
+        # every VALID frontier row classified exactly once (shard-0
+        # fold: the psum must not multiply by the shard count)
+        assert hit + miss == int(c[qm.FRONTIER_VALID])
+        assert hit > 0 and miss > 0      # a random 2-split has both
+        d = qm.derive(c)
+        assert d["locality_hit_rate"] == pytest.approx(
+            hit / (hit + miss))
+
+    def test_engine_validations(self, world):
+        model, params, ij, xj, feat = world
+        dist = self._dist(feat, 32)
+        with pytest.raises(ValueError, match="hop count"):
+            qv.ShardedServeEngine(model, params, (ij, xj), dist,
+                                  sizes_variants=[FULL, [2]],
+                                  batch_cap=CAP)
+        rep = self._dist(feat, 32)
+        rep._rep_args = object()         # a replicated-tail store
+        with pytest.raises(ValueError, match="replicated-tail"):
+            qv.ShardedServeEngine(model, params, (ij, xj), rep,
+                                  sizes_variants=[FULL], batch_cap=CAP)
+
+    def test_server_snapshot_names_partition(self, world):
+        sharded, _ = self._engines(world, 32)
+        srv = qv.MicroBatchServer(sharded,
+                                  qv.ServeConfig(max_wait_ms=1.0))
+        try:
+            assert srv.submit(3).result(timeout=30).shape == (CLASSES,)
+            rec = srv.snapshot()["serving"]
+            assert rec["partition"] == {"home": 0, "partitions": 2}
+        finally:
+            srv.close()
