@@ -697,6 +697,67 @@ def main():
     except Exception as e:          # the A/B must never fail a run
         print(f"fused hop A/B failed: {e!r}", file=sys.stderr)
 
+    # ---- qt-fuse-deep figure: the whole ladder in one program ----
+    # Multi-hop extension of the A/B above at the production fanouts
+    # [15,10,5]: fused (`fused_multihop` — interior hops sample
+    # in-kernel, compaction between hops, only leaf rows ever written,
+    # the WHOLE walk one jitted program) vs split (per-hop
+    # `sample_layer_pallas` + compaction + the jnp row gather — ids
+    # round-tripping through HBM every hop, one dispatch per op). The
+    # modeled index bytes for the walk live under the registry's
+    # `fused_multihop` entry and are pinned at zero by test_analysis;
+    # here the timed ratio is the trajectory figure. Batch stays small:
+    # the frontier cap grows multiplicatively (bs·16·11·6) and under
+    # CPU interpret the leaf gather emulates its DMAs serially.
+    def measure_fused_multihop_ab(reps=5):
+        import numpy as _np
+        from quiver_tpu.ops import quant
+        from quiver_tpu.ops.pallas.fused import (default_interpret,
+                                                 default_rng,
+                                                 fused_multihop,
+                                                 fused_multihop_reference,
+                                                 pad_indices)
+        rf = _np.random.default_rng(18)
+        n_f, dim_f, bs_f, cap_f = 4096, 128, 8, 128
+        sizes_f = [15, 10, 5]
+        deg_f = rf.integers(0, 24, n_f)
+        ip = _np.zeros(n_f + 1, _np.int64)
+        ip[1:] = _np.cumsum(deg_f)
+        ip = jnp.asarray(ip.astype(_np.int32))
+        ix = pad_indices(jnp.asarray(
+            rf.integers(0, n_f, int(deg_f.sum())).astype(_np.int32)),
+            cap_f)
+        fq = quant.quantize(jnp.asarray(
+            rf.standard_normal((n_f, dim_f)).astype(_np.float32)),
+            "int8")
+        sds = jnp.asarray(
+            rf.choice(n_f, bs_f, replace=False).astype(_np.int32))
+        rng_f, interp = default_rng(), default_interpret()
+
+        def run_pair(fn):
+            jax.block_until_ready(fn(0))                # compile
+            t0 = time.perf_counter()
+            for r in range(reps):
+                out = fn(r + 1)
+            jax.block_until_ready(out)
+            return reps / (time.perf_counter() - t0)
+
+        fused_sps = run_pair(lambda s: fused_multihop(
+            ip, ix, sds, fq, sizes_f,
+            jax.random.fold_in(jax.random.key(0), s), row_cap=cap_f,
+            rng=rng_f, interpret=interp))
+        split_sps = run_pair(lambda s: fused_multihop_reference(
+            ip, ix, sds, fq, sizes_f,
+            jax.random.fold_in(jax.random.key(0), s), row_cap=cap_f,
+            rng=rng_f, interpret=interp))
+        return fused_sps / split_sps
+
+    fused_multihop_vs_split_steps_per_s = None
+    try:
+        fused_multihop_vs_split_steps_per_s = measure_fused_multihop_ab()
+    except Exception as e:          # the A/B must never fail a run
+        print(f"fused multihop A/B failed: {e!r}", file=sys.stderr)
+
     # ---- qt-shard figures: serving over the partitioned store ----
     # A 2-partition block-clustered world served by one homed
     # ShardedServeEngine: aggregate seeds/sec through the jitted
@@ -876,6 +937,10 @@ def main():
             (round(fused_vs_split_steps_per_s, 4)
              if fused_vs_split_steps_per_s is not None else None),
         "fused_gather_index_bytes": fused_gather_index_bytes,
+        "fused_multihop_vs_split_steps_per_s":
+            (round(fused_multihop_vs_split_steps_per_s, 4)
+             if fused_multihop_vs_split_steps_per_s is not None
+             else None),
         # qt-shard: serving over the 2-partition sharded store —
         # aggregate seeds/sec through the jitted shard_map serve step,
         # its per-batch dispatch p99 (bench_regress tracks it

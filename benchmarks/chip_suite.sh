@@ -114,10 +114,16 @@ fi
 # the split two-program oracle, fused/split steps-per-s ratio, modeled
 # gather_index_bytes=0. Runs on the chip; the CPU interpret-mode A/B
 # (the equivalence half on any box) is exercised by the fuse section's
-# second line — keep both lines green
+# second line — keep both lines green. Round 21 (qt-fuse-deep) adds
+# the multi-hop pair: the whole [15,10,5] ladder as ONE fused program
+# vs the per-hop split walk — same bit-equal hard gate, whole-walk
+# steps-per-s ratio, modeled index bytes zero across ALL hops (the
+# CPU-interpret line is the smoke figure; the chip line is the record)
 if want fuse; then
     step python -u benchmarks/bench_fused.py
     step env JAX_PLATFORMS=cpu python -u benchmarks/bench_fused.py --iters 2
+    step python -u benchmarks/bench_fused.py --multihop
+    step env JAX_PLATFORMS=cpu python -u benchmarks/bench_fused.py --multihop --iters 2
 fi
 
 # feature gather GB/s: raw device + pallas (128-aligned and padded)

@@ -398,11 +398,60 @@ def _fused_hot_hop() -> List[EntrySpec]:
             tier_budgets=((wide, budget, 0),))]
 
 
+def _fused_multihop() -> List[EntrySpec]:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ..ops import quant
+    from ..ops.pallas.fused import (default_interpret, fused_multihop,
+                                    pad_indices)
+    fx = _fixture()
+    sizes, row_cap = [3, 2], 64
+    rng = np.random.default_rng(3)
+    # same lane-aligned dim-128 table as the single-hop entry (per-row
+    # feature DMAs need a multiple-of-128 row width)
+    wide = jnp.asarray(
+        rng.standard_normal((fx.n, 128)).astype(np.float32))
+    feat_q = quant.quantize(wide, "int8")
+    idx = pad_indices(fx.indices, row_cap)
+    interpret = default_interpret()
+
+    def make(feat):
+        def fn(indptr, indices_padded, seeds, key):
+            # the whole fused walk — interior sampling-only hops,
+            # leaf sample+gather hop, gather-free compaction and the
+            # frontier-block reassembly: the multi-hop train/serve
+            # front-end whose modeled gather_index_bytes must be 0
+            return fused_multihop(indptr, indices_padded, seeds, feat,
+                                  sizes, key, row_cap=row_cap,
+                                  rng="hash", interpret=interpret)
+        return fn
+
+    args = (fx.indptr, idx, fx.seeds, jax.random.key(11))
+    # tier rows the LEAF kernel DMAs per call: its seed block is the
+    # hop-0 frontier cap (8 * (1+3) = 32) padded to one 128-seed grid
+    # block, each block reading (1 + k_leaf) rows per seed; interior
+    # hops never touch the tier
+    budget = 128 * (1 + sizes[-1])
+    return [
+        EntrySpec(
+            name="fused_multihop", fn=make(feat_q), args=args,
+            tier_budgets=((feat_q, budget, 0),),
+            census=CensusSpec({"variant": ("quantized", "plain")},
+                              max_programs=2),
+            detail={"sizes": tuple(sizes), "row_cap": row_cap,
+                    "rng": "hash"}),
+        EntrySpec(
+            name="fused_multihop[plain]", fn=make(wide), args=args,
+            tier_budgets=((wide, budget, 0),))]
+
+
 register_entry("train_step", _train_step, quick=True)
 register_entry("lookup_tiered", _lookup_tiered, quick=True)
 register_entry("dist_lookup", _dist_lookup, quick=True)
 register_entry("serve_step", _serve_step, quick=True)
 register_entry("sharded_serve_step", _sharded_serve_step, quick=True)
 register_entry("fused_hot_hop", _fused_hot_hop, quick=True)
+register_entry("fused_multihop", _fused_multihop, quick=True)
 register_entry("e2e_train_step", _e2e_train_step)
 register_entry("dist_train_step", _dist_train_step)

@@ -31,11 +31,27 @@ list never round-trips through HBM between a sample program and a
 gather program — something no jnp graph can express (XLA materializes
 the ids between the two gathers). It IS reachable from production
 builders, strictly opt-in: ``build_train_step(fused_hot_hop=True)`` /
-``build_serve_step(fused_hot_hop=True)`` / ``ServeEngine``, single-hop
-exact method only, with the jnp split path as the default and the
+``build_serve_step(fused_hot_hop=True)`` / ``ServeEngine``, exact
+method only, with the jnp split path as the default and the
 bit-equivalence oracle (``fused_hot_hop_reference``, pinned in
-``tests/test_fused.py``). Shared DMA/window/PRNG helpers for all three
-kernels live in ``_dma.py``.
+``tests/test_fused.py``).
+
+Round 21 (qt-fuse-deep) lifts the single-hop restriction: the same
+knob now engages ``fused_multihop`` for ANY fanout ladder — interior
+hops run the sampling-only kernel variant (degrees/starts resolve
+in-kernel, no XLA indptr gather), the sort-based gather-free
+``compact_layer`` dedups between hops, and only the LEAF hop's feature
+rows are ever written to HBM, so the modeled ``gather_index_bytes`` is
+zero across every hop. The whole walk — kernels, compaction, the final
+two-scatter row reassembly — compiles as one program.
+``build_e2e_train_step`` and the hot-tier leg of
+``build_sharded_serve_step`` take the same knob; the split oracle is
+``fused_multihop_reference``, bit-equality pinned in
+``tests/test_fused.py``. Per-hop frontier budgets truncate exactly as
+the split path's ``compact_layer`` budgets do — duplicates compact
+first, overflow drops from the tail — so fused and split walks always
+agree bit-for-bit, truncation included. Shared DMA/window/PRNG helpers
+for all kernels live in ``_dma.py``.
 """
 
 __all__ = []

@@ -31,11 +31,32 @@ feature rows of every seed and every pick before returning:
       ``quant.gather_rows``), multiply-masking invalid (-1 / cold) rows
       to zero exactly like ``masked_feature_gather``.
 
+Round 21 (qt-fuse-deep) extends the path to the FULL fanout ladder:
+``fused_multihop`` walks every hop with the same kernel family —
+interior hops run the sampling-only variant (phase A alone, with the
+``indptr`` pairs still resolved in-kernel, so no hop ever issues an
+XLA gather), the gather-free sort-based ``compact_layer`` dedups each
+picked frontier into the next hop's static-budget seed block, and the
+LEAF hop runs the full sample+gather kernel. Because every hop's
+compacted frontier keeps the previous frontier as its slot-[0, v)
+prefix, the leaf hop's seeds ARE the whole walk's interior — one
+in-kernel gather over (leaf seeds + leaf picks) covers every frontier
+node, and the assembled ``[cap, dim]`` block is bit-identical to the
+split oracle's ``masked_feature_gather`` over the final ``n_id``
+(valid slots; never-touched padding slots are +0.0 here vs the
+oracle's multiply-masked signed zero — same documented wobble as the
+single-hop reassembly). ``gather_index_bytes == 0`` across ALL hops is
+therefore a verifiable model output for the multi-hop entry too.
+
 Scope and contract:
 
-- single hop, hot tier only. Picks whose storage row falls outside
+- hot tier only. Picks whose storage row falls outside
   ``hot_rows`` (cold tier) come back zero-masked alongside valid=False
   semantics; callers route them to the unchanged tiered lookup.
+- per-hop dedup-budget truncation: each hop's compacted frontier is a
+  STATIC ``s_i * (1 + k_i)`` budget (the ``layer_shapes`` capacity the
+  split path uses) — duplicates collapse, never truncate, so the
+  budgets are exact, not lossy.
 - ``row_cap`` truncation is inherited from ``sample_kernel``: rows with
   degree > row_cap sample uniformly from their first row_cap neighbors.
 - with ``rng="hash"`` the kernel is bit-identical, under interpret mode,
@@ -76,8 +97,9 @@ default_interpret = _dma.default_interpret
 pad_indices = _dma.pad_indices
 
 
-def _make_fused_kernel(*, k, row_cap, rng, n_nodes, n_order, tier_n,
-                       hot_rows, dim, out_dt, quantized, has_forder):
+def _make_fused_kernel(*, k, row_cap, rng, n_nodes, n_order=0, tier_n=1,
+                       hot_rows=0, dim=0, out_dt=None, quantized=False,
+                       has_forder=False, with_gather=True):
     win = _dma.win(row_cap)
     n_rows = BLOCK * (1 + k)        # seeds first, then flattened picks
 
@@ -87,30 +109,33 @@ def _make_fused_kernel(*, k, row_cap, rng, n_nodes, n_order, tier_n,
         seed_ref = next(it)
         indptr_hbm = next(it)
         indices_hbm = next(it)
-        data_hbm = next(it)
-        scale_hbm = next(it) if quantized else None
-        zero_hbm = next(it) if quantized else None
-        forder_hbm = next(it) if has_forder else None
+        if with_gather:
+            data_hbm = next(it)
+            scale_hbm = next(it) if quantized else None
+            zero_hbm = next(it) if quantized else None
+            forder_hbm = next(it) if has_forder else None
         nbrs_ref = next(it)
         cnt_ref = next(it)
-        seed_rows_ref = next(it)
-        pick_rows_ref = next(it)
+        if with_gather:
+            seed_rows_ref = next(it)
+            pick_rows_ref = next(it)
         ptr_smem = next(it)
         ptr_sems = next(it)
         rows_vmem = next(it)
         row_sems = next(it)
-        picks_smem = next(it)
-        pick_sem = next(it)
-        code_vmem = next(it)
-        feat_sems = next(it)
-        if quantized:
-            scale_smem = next(it)
-            zero_smem = next(it)
-            scale_sems = next(it)
-            zero_sems = next(it)
-        if has_forder:
-            tid_smem = next(it)
-            tid_sem = next(it)
+        if with_gather:
+            picks_smem = next(it)
+            pick_sem = next(it)
+            code_vmem = next(it)
+            feat_sems = next(it)
+            if quantized:
+                scale_smem = next(it)
+                zero_smem = next(it)
+                scale_sems = next(it)
+                zero_sems = next(it)
+            if has_forder:
+                tid_smem = next(it)
+                tid_sem = next(it)
 
         blk = pl.program_id(0)
         rand_bits = make_rand_bits(rng, seed_ref[0], blk)
@@ -180,6 +205,9 @@ def _make_fused_kernel(*, k, row_cap, rng, n_nodes, n_order, tier_n,
             valid_i = i < counts
             nbrs_ref[:, i] = jnp.where(valid_i, sel.astype(jnp.int32), -1)
         cnt_ref[0] = counts
+
+        if not with_gather:     # sampling-only variant stops here
+            return
 
         # ---- phase B: gather (frontier ids never leave the core) ----
         # picks to SMEM once — the scalar-addressable space the DMA
@@ -437,6 +465,237 @@ def fused_hot_hop(indptr, indices_padded, seeds, feat, k, seed,
                           hot_rows)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("k", "row_cap", "rng", "interpret"))
+def _fused_sample_hop(indptr, indices_padded, seeds, k, seed,
+                      row_cap, rng, interpret):
+    """Sampling-only variant of the fused kernel (phase A alone): the
+    ``indptr`` pairs are still resolved IN-KERNEL, so unlike the
+    ``sample_layer_pallas`` wrapper (whose XLA-side ``indptr[safe]`` /
+    ``indptr[safe+1]`` reads are gathers the cost model prices) an
+    interior hop contributes zero ``gather_index_bytes``."""
+    n_nodes = indptr.shape[0] - 1
+    bs = seeds.shape[0]
+    pad = (-bs) % BLOCK
+    if pad:
+        seeds = jnp.concatenate(
+            [seeds, jnp.full((pad,), -1, seeds.dtype)])
+    padded_bs = seeds.shape[0]
+    grid = padded_bs // BLOCK
+
+    kernel = _make_fused_kernel(
+        k=k, row_cap=row_cap, rng=rng, n_nodes=n_nodes,
+        with_gather=False)
+
+    in_specs = [
+        pl.BlockSpec((BLOCK,), lambda b: (b,), memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    operands = [seeds.astype(jnp.int32),
+                jnp.asarray(seed, jnp.int32).reshape(1),
+                indptr.astype(jnp.int32),
+                indices_padded]
+    scratch = [
+        pltpu.SMEM((BLOCK, 2), jnp.int32),        # indptr pairs
+        pltpu.SemaphoreType.DMA((BLOCK,)),
+        pltpu.VMEM((BLOCK, _dma.win(row_cap)), indices_padded.dtype),
+        pltpu.SemaphoreType.DMA((BLOCK,)),
+    ]
+    idx_item = jnp.dtype(indices_padded.dtype).itemsize
+    bytes_accessed = grid * (
+        BLOCK * 4                                  # seeds (SMEM block)
+        + BLOCK * 2 * 4                            # indptr pairs
+        + BLOCK * _dma.win(row_cap) * idx_item     # CSR staging windows
+        + BLOCK * (k + 1) * 4)                     # nbrs + counts out
+
+    nbrs, cnt = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((BLOCK, k), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((padded_bs, k), jnp.int32),
+            jax.ShapeDtypeStruct((grid, BLOCK), jnp.int32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=0, transcendentals=0,
+            bytes_accessed=int(bytes_accessed)),
+        compiler_params=_compiler_params(has_side_effects=True),
+    )(*operands)
+    return nbrs[:bs], cnt.reshape(-1)[:bs]
+
+
+def fused_sample_hop(indptr, indices_padded, seeds, k, seed,
+                     row_cap: int = 2048, rng: str | None = None,
+                     interpret: bool | None = None):
+    """One gather-free fused hop: phase A of the fused kernel — in-kernel
+    ``indptr`` resolution, CSR window staging, Fisher-Yates picks —
+    without the feature pipeline. Bit-identical picks to
+    ``sample_layer_pallas`` with the same rng/seed; zero
+    ``gather_index_bytes`` (the split wrapper's XLA indptr reads are
+    gathers, this one's are kernel DMAs)."""
+    if rng is None:
+        rng = default_rng()
+    if interpret is None:
+        interpret = default_interpret()
+    return _fused_sample_hop(indptr, indices_padded, seeds, k, seed,
+                             row_cap, rng, interpret)
+
+
+def _hop_seed(key, i):
+    """Per-hop kernel-PRNG seed. Hop 0 reduces exactly to the single-hop
+    builders' ``fold_in(key, 0)`` derivation, so a 1-element ``sizes``
+    ladder is bit-identical to the qt-fuse path."""
+    info = jnp.iinfo(jnp.int32)
+    return jax.random.randint(jax.random.fold_in(key, i), (),
+                              info.min, info.max, jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("sizes", "row_cap", "rng",
+                                             "interpret"))
+def _sample_multihop_impl(indptr, indices_padded, seeds, key, *, sizes,
+                          row_cap, rng, interpret):
+    from ..sample import compact_layer
+    cur = seeds.astype(jnp.int32)
+    layers = []
+    for i, k in enumerate(sizes):
+        with jax.named_scope(f"qt_fused_hop{i}"):
+            nbrs, _ = _fused_sample_hop(
+                indptr, indices_padded, cur, int(k), _hop_seed(key, i),
+                row_cap, rng, interpret)
+            layers.append(compact_layer(cur, nbrs, seeds_dense=True))
+        cur = layers[-1].n_id
+    return cur, layers
+
+
+def fused_sample_multihop(indptr, indices_padded, seeds, sizes, key,
+                          row_cap: int = 2048, rng: str | None = None,
+                          interpret: bool | None = None):
+    """Walk the whole fanout ladder with the sampling-only fused kernel:
+    every hop's degrees/starts resolve in-kernel, the sort-based
+    (gather-free) ``compact_layer`` dedups each picked frontier into the
+    next hop's static seed budget. Drop-in for ``sample_multihop`` on
+    exact-method ladders when the caller does its own feature lookup
+    (the sharded serve step's ``dist_lookup_local`` leg) — returns
+    ``(n_id, layers)`` with the identical static ``layer_shapes``
+    budgets. ``seeds`` must be dense (distinct valid ids, -1 tail only);
+    compaction keeps every hop's output dense. The whole walk — kernels
+    AND inter-hop compaction — is one jitted program: standalone callers
+    pay one dispatch, not one per hop."""
+    if not sizes:
+        raise ValueError("sizes must name at least one hop")
+    if rng is None:
+        rng = default_rng()
+    if interpret is None:
+        interpret = default_interpret()
+    return _sample_multihop_impl(
+        indptr, indices_padded, seeds, key,
+        sizes=tuple(int(k) for k in sizes), row_cap=int(row_cap),
+        rng=rng, interpret=interpret)
+
+
+def fused_multihop(indptr, indices_padded, seeds, feat, sizes, key,
+                   row_cap: int = 2048, rng: str | None = None,
+                   interpret: bool | None = None,
+                   feature_order=None, hot_rows: int | None = None):
+    """The full fused frontier walk: interior hops run the sampling-only
+    kernel (``fused_sample_hop`` — in-kernel indptr, no XLA gather), the
+    LEAF hop runs the sample+gather kernel, and the gather-free
+    ``compact_layer`` dedups between hops. Because each compacted
+    frontier keeps its predecessor as the slot-[0, v) prefix, the leaf
+    hop's seeds are the entire interior — its in-kernel gather over
+    (seeds + picks) covers every frontier node, and the two-scatter
+    reassembly below yields the final ``[cap, dim]`` block with no HBM
+    id round trip anywhere: ``gather_index_bytes == 0`` across ALL hops.
+
+    Returns ``(n_id, layers, x)`` — the same triple shape the split
+    ``sample_multihop`` + ``masked_feature_gather`` pair produces, with
+    ``x`` bit-identical on valid slots (never-scattered padding slots
+    are +0.0 vs the oracle's multiply-masked signed zero — the
+    documented single-hop wobble; losses/logits still pin bit-equal).
+    ``seeds`` must be dense (distinct valid ids, -1 tail only). Per-hop
+    kernel seeds derive from ``fold_in(key, i)``; a 1-hop ladder is
+    bit-identical to the qt-fuse single-hop path. Like the sampling-only
+    walk, the whole ladder compiles to ONE program — hops, compaction
+    and the two-scatter reassembly dispatch together."""
+    if not sizes:
+        raise ValueError("sizes must name at least one hop")
+    if rng is None:
+        rng = default_rng()
+    if interpret is None:
+        interpret = default_interpret()
+    return _multihop_impl(
+        indptr, indices_padded, seeds, feat, key, feature_order,
+        sizes=tuple(int(k) for k in sizes), row_cap=int(row_cap),
+        rng=rng, interpret=interpret,
+        hot_rows=None if hot_rows is None else int(hot_rows))
+
+
+@functools.partial(jax.jit, static_argnames=("sizes", "row_cap", "rng",
+                                             "interpret", "hot_rows"))
+def _multihop_impl(indptr, indices_padded, seeds, feat, key,
+                   feature_order, *, sizes, row_cap, rng, interpret,
+                   hot_rows):
+    from ..sample import compact_layer
+    cur = seeds.astype(jnp.int32)
+    layers = []
+    last = len(sizes) - 1
+    for i, k in enumerate(sizes):
+        with jax.named_scope(f"qt_fused_hop{i}"):
+            if i < last:
+                nbrs, _ = _fused_sample_hop(
+                    indptr, indices_padded, cur, int(k),
+                    _hop_seed(key, i), row_cap, rng, interpret)
+            else:
+                leaf_seeds = cur
+                nbrs, _, seed_rows, pick_rows = _fused_hot_hop(
+                    indptr, indices_padded, cur, feat, int(k),
+                    _hop_seed(key, i), row_cap, rng, interpret,
+                    feature_order, hot_rows)
+            layers.append(compact_layer(cur, nbrs, seeds_dense=True))
+        cur = layers[-1].n_id
+    leaf = layers[-1]
+    s = leaf_seeds.shape[0]
+    cap = leaf.n_id.shape[0]
+    x = jnp.zeros((cap, seed_rows.shape[1]), seed_rows.dtype)
+    # valid leaf seed i owns slot i (dense invariant kept by every
+    # compaction); each valid pick's col is its compacted slot.
+    # Duplicates carry identical bits so the scatter is
+    # order-independent; -1s route to the dropped slot ``cap``.
+    x = x.at[jnp.where(leaf_seeds >= 0, jnp.arange(s), cap)].set(
+        seed_rows, mode="drop")
+    x = x.at[jnp.where(leaf.col >= 0, leaf.col, cap)].set(
+        pick_rows, mode="drop")
+    return leaf.n_id, layers, x
+
+
+def _oracle_rows(feat, ids, feature_order, hot_rows):
+    """The jnp reference lookup the fused gather must match bit-for-bit:
+    ``feature_order`` translation, hot-tier bounds check, and the
+    multiply-mask that zeroes invalid/cold rows."""
+    tier_n = quant.tier_rows(feat)
+    if feature_order is not None:
+        t = feature_order[jnp.clip(ids, 0,
+                                   feature_order.shape[0] - 1)]
+        hot = tier_n if hot_rows is None else hot_rows
+        valid = (ids >= 0) & (t < hot)
+        safe = jnp.clip(t, 0, tier_n - 1)
+    else:
+        valid = ids >= 0
+        safe = jnp.clip(ids, 0, tier_n - 1)
+    x = quant.gather_rows(feat, safe)
+    return x * valid.astype(x.dtype)[:, None]
+
+
 def fused_hot_hop_reference(indptr, indices_padded, seeds, feat, k,
                             seed, row_cap: int = 2048,
                             rng: str = "hash",
@@ -452,20 +711,37 @@ def fused_hot_hop_reference(indptr, indices_padded, seeds, feat, k,
     nbrs, counts = sample_layer_pallas(
         indptr, indices_padded, seeds, k, seed, row_cap=row_cap,
         rng=rng, interpret=interpret)
+    return (nbrs, counts,
+            _oracle_rows(feat, seeds, feature_order, hot_rows),
+            _oracle_rows(feat, nbrs.reshape(-1).astype(jnp.int32),
+                         feature_order, hot_rows))
 
-    def rows_of(ids):
-        tier_n = quant.tier_rows(feat)
-        if feature_order is not None:
-            t = feature_order[jnp.clip(ids, 0,
-                                       feature_order.shape[0] - 1)]
-            hot = tier_n if hot_rows is None else hot_rows
-            valid = (ids >= 0) & (t < hot)
-            safe = jnp.clip(t, 0, tier_n - 1)
-        else:
-            valid = ids >= 0
-            safe = jnp.clip(ids, 0, tier_n - 1)
-        x = quant.gather_rows(feat, safe)
-        return x * valid.astype(x.dtype)[:, None]
 
-    return (nbrs, counts, rows_of(seeds),
-            rows_of(nbrs.reshape(-1).astype(jnp.int32)))
+def fused_multihop_reference(indptr, indices_padded, seeds, feat, sizes,
+                             key, row_cap: int = 2048,
+                             rng: str = "hash",
+                             interpret: bool | None = None,
+                             feature_order=None,
+                             hot_rows: int | None = None):
+    """The split multi-hop oracle: per-hop ``sample_layer_pallas`` (same
+    rng and ``fold_in(key, i)`` seeds, frontier ids round-tripping
+    through HBM every hop) + ``compact_layer`` + one jnp gather over the
+    final frontier. With ``rng="hash"`` under interpret mode,
+    ``fused_multihop`` matches this bit-for-bit on ``n_id``, the layer
+    COOs, and every valid row of ``x`` — the multi-hop acceptance
+    gate."""
+    if not sizes:
+        raise ValueError("sizes must name at least one hop")
+    if interpret is None:
+        interpret = default_interpret()
+    from ..sample import compact_layer
+    cur = seeds.astype(jnp.int32)
+    layers = []
+    for i, k in enumerate(sizes):
+        nbrs, _ = sample_layer_pallas(
+            indptr, indices_padded, cur, int(k), _hop_seed(key, i),
+            row_cap=row_cap, rng=rng, interpret=interpret)
+        layers.append(compact_layer(cur, nbrs, seeds_dense=True))
+        cur = layers[-1].n_id
+    x = _oracle_rows(feat, cur, feature_order, hot_rows)
+    return cur, layers, x

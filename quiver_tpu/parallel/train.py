@@ -111,65 +111,51 @@ def dedup_feature_gather(feat, n_id: jax.Array,
                         narrow, None)
 
 
-def _fused_hot_hop_x(feat, forder, indptr, indices, seeds, k, key,
-                     row_cap=2048, rng=None, interpret=None,
-                     hot_rows=None, collector=None):
-    """One fused sample+gather hop (``ops.pallas.fused``): reservoir
-    picks AND their dequantized feature rows come out of ONE Pallas
-    kernel, so the frontier id list lives only in VMEM/SMEM — no
-    ``n_id`` HBM array between programs, zero gather indexing traffic.
-    The layer COO and the ``[cap, dim]`` frontier block are reassembled
-    here bit-identically to ``masked_feature_gather(feat, n_id,
-    forder)`` over the same picks.
+def _fused_multihop_x(feat, forder, indptr, indices, seeds, sizes, key,
+                      row_cap=2048, rng=None, interpret=None,
+                      hot_rows=None, collector=None):
+    """The fused frontier walk (``ops.pallas.fused.fused_multihop``):
+    interior hops run the sampling-only fused kernel (in-kernel indptr
+    resolution), the leaf hop samples AND gathers in one kernel, and
+    the gather-free compaction chains them — frontier ids live only in
+    VMEM/SMEM at every hop, so the step's modeled
+    ``gather_index_bytes`` is zero across the whole ladder. The layer
+    COOs and the ``[cap, dim]`` frontier block come back bit-identical
+    to ``masked_feature_gather(feat, n_id, forder)`` over the same
+    picks (valid slots).
 
-    Single-hop builders only. The sampling PRNG is the KERNEL's stream
-    (seeded from ``fold_in(key, 0)``), not ``jax.random`` — losses are
+    The sampling PRNG is the KERNEL's stream (hop ``i`` seeded from
+    ``fold_in(key, i)``), not ``jax.random`` — losses are
     bit-comparable with the split Pallas oracle
-    (``ops.pallas.fused.fused_hot_hop_reference``), not with the
-    ``sample_multihop`` path. ``hot_rows`` zeroes rows whose
+    (``ops.pallas.fused.fused_multihop_reference``), not with the
+    ``sample_multihop`` path. A 1-hop ``sizes`` reduces exactly to the
+    qt-fuse single-hop behavior. ``hot_rows`` zeroes rows whose
     (``forder``-translated) storage row falls outside the hot tier;
     callers with a cold tier overlay exactly those slots afterwards
     (the serve step's tiered fixup)."""
-    from ..ops.pallas.fused import fused_hot_hop, pad_indices
-    from ..ops.sample import compact_layer
-    info = jnp.iinfo(jnp.int32)
-    seed = jax.random.randint(jax.random.fold_in(key, 0), (),
-                              info.min, info.max, jnp.int32)
-    nbrs, _counts, seed_rows, pick_rows = fused_hot_hop(
-        indptr, pad_indices(indices, row_cap), seeds, feat, k, seed,
-        row_cap=row_cap, rng=rng, interpret=interpret,
+    from ..ops.pallas.fused import fused_multihop, pad_indices
+    n_id, layers, x = fused_multihop(
+        indptr, pad_indices(indices, row_cap), seeds, feat, list(sizes),
+        key, row_cap=row_cap, rng=rng, interpret=interpret,
         feature_order=forder, hot_rows=hot_rows)
-    layer = compact_layer(seeds, nbrs, seeds_dense=True)
-    s = seeds.shape[0]
-    cap = s * (1 + k)
-    x = jnp.zeros((cap, seed_rows.shape[1]), seed_rows.dtype)
-    # valid seed i owns slot i (the dense-seed invariant); each valid
-    # pick's col is its compacted slot. Duplicate picks — and picks
-    # equal to a seed — carry the SAME dequantized bits, so the scatter
-    # is order-independent; -1s route to the dropped slot ``cap``.
-    x = x.at[jnp.where(seeds >= 0, jnp.arange(s), cap)].set(
-        seed_rows, mode="drop")
-    x = x.at[jnp.where(layer.col >= 0, layer.col, cap)].set(
-        pick_rows, mode="drop")
     if collector is not None:
         from ..metrics import FRONTIER_CAP, FRONTIER_VALID
-        collector.add(FRONTIER_VALID, jnp.sum(layer.n_id >= 0))
-        collector.add(FRONTIER_CAP, int(layer.n_id.shape[0]))
-    return x, [layer]
+        collector.add(FRONTIER_VALID, jnp.sum(n_id >= 0))
+        collector.add(FRONTIER_CAP, int(n_id.shape[0]))
+    return x, layers
 
 
 def _fused_knobs(enabled, row_cap, rng, interpret, sizes, method,
                  dedup_gather=None, indices_stride=None, hub_frac=None):
     """Validate + pack the ``fused_hot_hop`` builder knobs (shared by
-    the train and serve builders). The fused kernel walks exactly one
-    exact-method hop and does its own in-kernel gather, so the knob
-    composes with nothing that reshapes sampling or the gather."""
+    the train and serve builders). The fused walk covers any
+    exact-method fanout ladder (qt-fuse-deep) and does its own
+    in-kernel gather, so the knob composes with nothing that reshapes
+    sampling or the gather."""
     if not enabled:
         return None
-    if len(sizes) != 1:
-        raise ValueError(
-            f"fused_hot_hop fuses a single hop; got sizes={list(sizes)} "
-            "(use the split path for multi-hop fanouts)")
+    if not sizes:
+        raise ValueError("fused_hot_hop needs at least one hop in sizes")
     if method != "exact":
         raise ValueError(
             f"fused_hot_hop requires method='exact', got {method!r}")
@@ -206,17 +192,17 @@ def _fused_loss(model, loss_fn, sizes, batch_size, params, feat, forder,
     also takes the cheaper dense-seed compaction path.
 
     ``fused`` (the packed ``fused_hot_hop`` builder knobs, see
-    ``_fused_knobs``) swaps the sample->gather pair for the
-    single-kernel Pallas hop (``_fused_hot_hop_x``) — frontier ids stay
-    on chip; everything from the frontier block on is unchanged."""
+    ``_fused_knobs``) swaps the sample->gather pair for the fused
+    Pallas walk (``_fused_multihop_x``) — frontier ids stay on chip at
+    EVERY hop; everything from the frontier block on is unchanged."""
     if fused is not None:
         if indices_rows is not None:
             raise TypeError(
-                "fused_hot_hop does not take indices_rows (it walks one "
-                "exact-method hop with its own in-kernel CSR reads)")
-        x, layers = _fused_hot_hop_x(feat, forder, indptr, indices,
-                                     seeds, sizes[0], key,
-                                     collector=collector, **fused)
+                "fused_hot_hop does not take indices_rows (the fused "
+                "walk does its own in-kernel CSR reads every hop)")
+        x, layers = _fused_multihop_x(feat, forder, indptr, indices,
+                                      seeds, sizes, key,
+                                      collector=collector, **fused)
     else:
         n_id, layers = sample_multihop(
             indptr, indices, seeds, sizes, key, method=method,
@@ -391,19 +377,21 @@ def build_train_step(model, tx, sizes: Sequence[int], batch_size: int,
     (``ops.quant.quantize(feat, "int8"|"bf16")``): dequant fuses into
     the gather and the model consumes float activations unchanged.
 
-    ``fused_hot_hop=True`` (single-hop ``sizes``, ``method="exact"``
-    only) swaps the sample->gather pair for the single-kernel Pallas
-    hop (``ops.pallas.fused``): reservoir sampling and the per-pick
-    feature-row DMA (int8 dequant applied in-register) run in ONE
-    kernel, so frontier ids never materialize in HBM and the step's
-    modeled ``gather_index_bytes`` is zero. ``fused_row_cap`` bounds
-    the in-VMEM CSR window per seed (degrees beyond it are truncated —
-    the sample kernel's contract); ``fused_rng``/``fused_interpret``
-    default to the backend-appropriate choices ("tpu" PRNG on TPU,
-    portable "hash" + interpret mode elsewhere). The fused step's
-    sampling stream is the kernel PRNG, so losses are not
-    bit-comparable with the split step — only with the split Pallas
-    oracle (``ops.pallas.fused.fused_hot_hop_reference``)."""
+    ``fused_hot_hop=True`` (any ``sizes`` ladder, ``method="exact"``
+    only) swaps the sample->gather pair for the fused Pallas walk
+    (``ops.pallas.fused.fused_multihop``): interior hops run the
+    sampling-only fused kernel, the leaf hop fuses reservoir sampling
+    with the per-pick feature-row DMA (int8 dequant applied
+    in-register), and frontier ids never materialize in HBM at ANY hop
+    — the step's modeled ``gather_index_bytes`` is zero across the
+    whole ladder. ``fused_row_cap`` bounds the in-VMEM CSR window per
+    seed (degrees beyond it are truncated — the sample kernel's
+    contract); ``fused_rng``/``fused_interpret`` default to the
+    backend-appropriate choices ("tpu" PRNG on TPU, portable "hash" +
+    interpret mode elsewhere). The fused step's sampling stream is the
+    kernel PRNG (hop ``i`` seeded from ``fold_in(key, i)``), so losses
+    are not bit-comparable with the split step — only with the split
+    Pallas oracle (``ops.pallas.fused.fused_multihop_reference``)."""
     sizes = list(sizes)
     gather = _dedup_gather_fn(dedup_gather)
     fused = _fused_knobs(fused_hot_hop, fused_row_cap, fused_rng,
@@ -455,7 +443,11 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                          donate: bool = True,
                          dedup_gather=None,
                          collect_metrics: bool = False,
-                         merge_counters: bool = False):
+                         merge_counters: bool = False,
+                         fused_hot_hop: bool = False,
+                         fused_row_cap: int = 2048,
+                         fused_rng: str | None = None,
+                         fused_interpret: bool | None = None):
     """Data-parallel fused step over ``mesh[axis]``:
     fn(state, feat, forder, indptr, indices, seeds, labels, key[,
     indices_rows]) with seeds/labels [n_dev * per_device_batch] sharded
@@ -468,9 +460,20 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
     ``dedup_gather`` (True or an int unique budget) swaps each shard's
     frontier feature gather for ``dedup_feature_gather``. ``feat`` may
     be a quantized store (``ops.quant``) — the P() spec broadcasts
-    over its leaves as a pytree prefix."""
+    over its leaves as a pytree prefix.
+
+    ``fused_hot_hop=True`` swaps each shard's sample->gather pair for
+    the fused Pallas walk (``ops.pallas.fused.fused_multihop``) with
+    the same contract as ``build_train_step``: exact method, any
+    ``sizes`` ladder, zero modeled ``gather_index_bytes`` per shard;
+    the per-shard key fold keeps shards on distinct kernel streams."""
     sizes = list(sizes)
     gather = _dedup_gather_fn(dedup_gather)
+    fused = _fused_knobs(fused_hot_hop, fused_row_cap, fused_rng,
+                         fused_interpret, sizes, method,
+                         dedup_gather=dedup_gather,
+                         indices_stride=indices_stride,
+                         hub_frac=hub_frac)
     if merge_counters and not collect_metrics:
         raise ValueError("merge_counters=True requires "
                          "collect_metrics=True")
@@ -485,7 +488,7 @@ def build_e2e_train_step(model, tx, sizes: Sequence[int],
                                        indptr, indices, seeds, labels, key,
                                        method, indices_rows, indices_stride,
                                        gather=gather, hub_frac=hub_frac,
-                                       collector=col))
+                                       collector=col, fused=fused))
         loss, counters, grads = unpack(loss_of(state.params))
         new_state, loss = _pmean_update(state, tx, grads, loss, axis)
         if collect_metrics:

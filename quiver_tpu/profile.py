@@ -236,7 +236,11 @@ class StageProfiler:
         single-kernel Pallas sample+gather hop (``fused_hot_hop`` —
         one hop at its own fixture shape, so compare its COST model
         line, ``gather_index_bytes=0``, rather than its wall time
-        against the two-hop stages)."""
+        against the two-hop stages). A fifth, ``fused_multihop``,
+        times the registry's full fused walk (qt-fuse-deep — the
+        sample+gather front-end the fused train step runs; same
+        cost-model reading, ``gather_index_bytes=0`` across ALL
+        hops)."""
         from .analysis.registry import _fixture, build_entry_specs
         from .ops.sample_multihop import sample_multihop
         from .parallel.train import masked_feature_gather
@@ -260,12 +264,14 @@ class StageProfiler:
                          donate_argnums=tuple(step.donate_argnums),
                          cost=cost_of(step)),
         ]
-        fused = build_entry_specs("fused_hot_hop")[0]
-        stages.append(ProfileStage(
-            "fused_hop",
-            fused.fn if hasattr(fused.fn, "_cache_size")
-            else jax.jit(fused.fn),
-            fused.args, cost=cost_of(fused)))
+        for stage_name, entry in (("fused_hop", "fused_hot_hop"),
+                                  ("fused_multihop", "fused_multihop")):
+            fused = build_entry_specs(entry)[0]
+            stages.append(ProfileStage(
+                stage_name,
+                fused.fn if hasattr(fused.fn, "_cache_size")
+                else jax.jit(fused.fn),
+                fused.args, cost=cost_of(fused)))
         return self.add_group(ProfileGroup("train_pipeline", stages,
                                            ref_stage="step"))
 

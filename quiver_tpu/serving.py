@@ -84,7 +84,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import faults, tracing
-from .parallel.train import (_fused_hot_hop_x, _fused_knobs,
+from .parallel.train import (_fused_knobs, _fused_multihop_x,
                              dedup_feature_gather, layers_to_adjs,
                              masked_feature_gather)
 from .profiling import hot_path
@@ -143,11 +143,12 @@ def build_serve_step(model, sizes: Sequence[int], batch_cap: int,
     ``.jitted_fns`` (for ``StepStats.watch_compiles``) and ``.raw``
     (the traceable body, for jaxpr pins like ``host_sync_eqns``).
 
-    ``fused_hot_hop=True`` (single-hop ``sizes``, ``method="exact"``)
-    swaps the sample+gather pair for the single-kernel Pallas hop
-    (``ops.pallas.fused``): picks and their dequantized hot-tier rows
-    come out of ONE kernel, frontier ids never touch HBM.
-    ``fused_hot_rows`` scopes the in-kernel gather to the hot tier;
+    ``fused_hot_hop=True`` (any ``sizes`` ladder, ``method="exact"``)
+    swaps the sample+gather pair for the fused Pallas walk
+    (``ops.pallas.fused.fused_multihop``): every hop samples in-kernel
+    (interior hops run the sampling-only kernel, the leaf hop also
+    gathers the dequantized hot-tier rows), frontier ids never touch
+    HBM. ``fused_hot_rows`` scopes the in-kernel gather to the hot tier;
     when a ``gather`` override is also given (the ``ServeEngine``'s
     tiered ``Feature`` splice, where ``feat`` is the ``(device_part,
     host)`` pytree and the kernel reads ``feat[0]``), the slots the
@@ -176,16 +177,17 @@ def build_serve_step(model, sizes: Sequence[int], batch_cap: int,
         key, sub = jax.random.split(key)
         if fused is not None:
             hot = feat[0] if gather is not None else feat
-            x, layers = _fused_hot_hop_x(
-                hot, forder, indptr, indices, seeds, sizes[0], sub,
+            x, layers = _fused_multihop_x(
+                hot, forder, indptr, indices, seeds, sizes, sub,
                 hot_rows=fused_hot_rows, collector=collector, **fused)
             if gather is not None:
-                # cold fixup: the kernel zeroed every pick whose
-                # translated row falls outside the hot tier; those
-                # slots — and ONLY those — come from the store's
+                # cold fixup: the kernel zeroed every frontier slot
+                # whose translated row falls outside the hot tier;
+                # those slots — and ONLY those — come from the store's
                 # unchanged tiered lookup (hot slots masked to -1 so
-                # the store reads nothing for them)
-                n_id = layers[0].n_id
+                # the store reads nothing for them). The FINAL layer's
+                # n_id is the whole walk's frontier.
+                n_id = layers[-1].n_id
                 t = forder[jnp.clip(n_id, 0)] if forder is not None \
                     else jnp.clip(n_id, 0)
                 is_cold = (n_id >= 0) & (t >= fused_hot_rows)
@@ -255,10 +257,11 @@ class ServeEngine:
     ``collect_metrics=True`` makes every ``run`` also emit the device
     counter vector (stashed on ``last_counters``; read it lazily).
 
-    ``fused_hot_hop=True`` (every variant single-hop, exact method)
-    builds each variant on the single-kernel Pallas sample+gather hop:
-    hot-tier rows come straight out of the sampling kernel and only
-    cold picks (when the store is tiered) take the split lookup. See
+    ``fused_hot_hop=True`` (exact method; any hop count — the ladder
+    variants share one census bound) builds each variant on the fused
+    Pallas walk: every hop samples in-kernel, the leaf hop gathers the
+    hot-tier rows in the same kernel, and only cold frontier slots
+    (when the store is tiered) take the split lookup. See
     ``build_serve_step``'s knob of the same name.
 
     ``run(seeds, variant=0)`` is NOT thread-safe (the donated key chain
@@ -455,7 +458,11 @@ def build_sharded_serve_step(model, sizes: Sequence[int], batch_cap: int,
                              method: str = "exact",
                              exchange_cap=None,
                              home: Optional[int] = None,
-                             collect_metrics: bool = False):
+                             collect_metrics: bool = False,
+                             fused_hot_hop: bool = False,
+                             fused_row_cap: int = 2048,
+                             fused_rng: Optional[str] = None,
+                             fused_interpret: Optional[bool] = None):
     """The serve step over a ``DistFeature``-partitioned store: ONE
     jitted ``shard_map`` program per fanout config whose gather stage is
     the PR 4 compact deduplicated exchange (``comm.dist_lookup_local``)
@@ -489,12 +496,25 @@ def build_sharded_serve_step(model, sizes: Sequence[int], batch_cap: int,
     multiply it by the shard count): owned by ``home`` ->
     ``locality_hit_rows``, owned elsewhere -> ``locality_miss_rows`` —
     the router-as-cache-policy payoff counters (miss rows are exactly
-    the rows the exchange must ship in from other partitions)."""
+    the rows the exchange must ship in from other partitions).
+
+    ``fused_hot_hop=True`` (exact method) swaps the replicated sampling
+    stage for the gather-free fused Pallas walk
+    (``ops.pallas.fused.fused_sample_multihop``): every hop's degrees
+    and CSR windows resolve in-kernel, so the sampling half contributes
+    zero ``gather_index_bytes`` — the hot-tier leg of the sharded step.
+    The feature rows still arrive through the unchanged partitioned
+    exchange (``dist_lookup_local``); picks come from the kernel PRNG
+    stream, so logits are bit-comparable with a fused single-store
+    ``build_serve_step`` over the same rows, not with the split sharded
+    step."""
     from .comm import default_exchange_cap, dist_lookup_local
     from ._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     sizes = list(sizes)
+    fused = _fused_knobs(fused_hot_hop, fused_row_cap, fused_rng,
+                         fused_interpret, sizes, method)
     h_count = mesh.shape[axis]
     if exchange_cap is True:
         from .pyg.sage_sampler import layer_shapes
@@ -515,9 +535,20 @@ def build_sharded_serve_step(model, sizes: Sequence[int], batch_cap: int,
         # psum to the true mesh-wide totals
         rep_col = Collector() if collect_metrics else None
         key, sub = jax.random.split(key)
-        n_id, layers = sample_multihop_serving(
-            indptr, indices, seeds, sizes, sub, method=method,
-            collector=rep_col)
+        if fused is not None:
+            from .ops.pallas.fused import (fused_sample_multihop,
+                                           pad_indices)
+            n_id, layers = fused_sample_multihop(
+                indptr, pad_indices(indices, fused["row_cap"]), seeds,
+                sizes, sub, **fused)
+            if rep_col is not None:
+                from .metrics import FRONTIER_CAP, FRONTIER_VALID
+                rep_col.add(FRONTIER_VALID, jnp.sum(n_id >= 0))
+                rep_col.add(FRONTIER_CAP, int(n_id.shape[0]))
+        else:
+            n_id, layers = sample_multihop_serving(
+                indptr, indices, seeds, sizes, sub, method=method,
+                collector=rep_col)
         x = dist_lookup_local(n_id, g2h, g2l, feat, axis, h_count,
                               rows_per_host, exchange_cap=exchange_cap,
                               collector=col)
@@ -570,7 +601,10 @@ class ShardedServeEngine:
     thread-safe; the ``MicroBatchServer`` funnels dispatches through
     its single pipeline worker), same bounded pre-compiled fanout
     ladder, and the logits are bit-identical to a single-store
-    ``ServeEngine`` over the unpartitioned array."""
+    ``ServeEngine`` over the unpartitioned array (with
+    ``fused_hot_hop=True`` on both — the fused sampling leg of
+    ``build_sharded_serve_step`` — the match is against the fused
+    single-store engine's kernel-PRNG stream)."""
 
     def __init__(self, model, params, topo, dist,
                  sizes_variants: Sequence[Sequence[int]],
@@ -578,6 +612,8 @@ class ShardedServeEngine:
                  method: str = "exact",
                  home: Optional[int] = None,
                  collect_metrics: bool = False,
+                 fused_hot_hop: bool = False,
+                 fused_row_cap: int = 2048,
                  seed: int = 0):
         if not sizes_variants:
             raise ValueError("need at least one fanout variant")
@@ -615,7 +651,9 @@ class ShardedServeEngine:
                 model, sizes, self.batch_cap, dist.comm.mesh,
                 dist.comm.axis, dist._rows_per_host, method=method,
                 exchange_cap=dist.exchange_cap, home=self.home,
-                collect_metrics=self.collect_metrics)
+                collect_metrics=self.collect_metrics,
+                fused_hot_hop=fused_hot_hop,
+                fused_row_cap=fused_row_cap)
             for sizes in self.variants]
         self._key = jax.random.key(seed)
 

@@ -190,6 +190,12 @@ def is_skipped(rec):
 #: frontier resident in the home partition's tier under
 #: locality-routed arrivals — losing it means the exchange is
 #: shipping rows the router was supposed to keep home.
+#: ``fused_multihop_vs_split_steps_per_s`` (qt-fuse-deep's whole-ladder
+#: A/B at the production fanouts, from ``bench.py``) joins in round
+#: 21: the one-program fused walk vs the per-hop split composition,
+#: higher is better; ``fused_gather_index_bytes`` keeps its zero-slack
+#: INVERTED gate so a reintroduced per-hop id round trip still fails
+#: the sweep.
 SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
                "cold_staged_rows_per_s", "gather_efficiency",
                "chaos_accepted_p99_ratio", "chaos_error_rate",
@@ -197,6 +203,7 @@ SUB_METRICS = ("cold_rows_per_s", "prefetch_hit_rate",
                "tail_rps_ratio", "tail_kept_frac",
                "fused_vs_split_steps_per_s",
                "fused_gather_index_bytes",
+               "fused_multihop_vs_split_steps_per_s",
                "adaptive_hit_rate", "adaptive_served_p99_ms",
                "sharded_agg_rps", "sharded_p99_ms",
                "locality_hit_rate")

@@ -1244,6 +1244,132 @@ def main():
           "narrow exchange and dense fallback, logits bit-identical "
           "to the unsharded replay)")
 
+    # ---- phase 15: fused multi-hop walk — 50 train + serve steps, ----
+    # ---- walk bit-identical to the split replay ----
+    # qt-fuse-deep's leak contract: the whole-ladder fused programs
+    # (the fused train step AND the fused serve step over the [3,2]
+    # ladder) each hold ONE executable across 50 same-shape
+    # dispatches — the in-kernel indptr hops, inter-hop compaction and
+    # leaf gather never re-trace — while every dispatch's losses and
+    # frontier rows stay bit-identical to the split two-program oracle
+    # (per-hop sample kernel + jnp gather) replayed on the same key.
+    from quiver_tpu.ops.pallas import fused as _fz
+    from quiver_tpu.ops.pallas.fused import (fused_multihop,
+                                             fused_multihop_reference,
+                                             pad_indices)
+    from quiver_tpu.parallel.train import (TrainState,
+                                           cross_entropy_logits)
+    from quiver_tpu.serving import build_serve_step
+
+    fu_cap = 64
+    featf = jnp.asarray(dfeat)
+    fidx = pad_indices(dindices_j, fu_cap)
+    flabels = jnp.asarray(dlabels)
+    fstep = build_train_step(dmodel, dtx, dsizes, dbs,
+                             fused_hot_hop=True, fused_row_cap=fu_cap)
+    fserve = build_serve_step(dmodel, dsizes, dbs, fused_hot_hop=True,
+                              fused_row_cap=fu_cap)
+
+    f_nid, f_layers = sample_multihop(dindptr_j, dindices_j,
+                                      jnp.arange(dbs, dtype=jnp.int32),
+                                      dsizes, jax.random.key(0))
+    f_state0 = init_state(dmodel, dtx,
+                          masked_feature_gather(featf, f_nid),
+                          layers_to_adjs(f_layers, dbs, dsizes),
+                          jax.random.key(2))
+    st_f = jax.tree_util.tree_map(jnp.array, f_state0)   # donated copy
+    st_o = f_state0
+
+    def f_oracle(state, seeds, key):
+        # the split replay of the fused train step's loss: identical
+        # PRNG stream (per-hop fold_in), identical dropout derivation
+        def loss_of(p):
+            n_id, layers, _ = fused_multihop_reference(
+                dindptr_j, fidx, seeds, featf, dsizes, key,
+                row_cap=fu_cap, rng="hash", interpret=True)
+            x = masked_feature_gather(featf, n_id, None)
+            adjs = layers_to_adjs(layers, dbs, dsizes)
+            logits = dmodel.apply(
+                p, x, adjs, train=True,
+                rngs={"dropout": jax.random.fold_in(key, 1000)})
+            return cross_entropy_logits(logits[:dbs], flabels[seeds])
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        updates, opt = dtx.update(grads, state.opt_state, state.params)
+        return TrainState(optax.apply_updates(state.params, updates),
+                          opt, state.step + 1), loss
+
+    f_oracle = jax.jit(f_oracle)
+
+    def f_batch():
+        return jnp.asarray(
+            rng.choice(dn, dbs, replace=False).astype(np.int32))
+
+    def f_iter(skey, serve_params):
+        seeds = f_batch()
+        # host-side mirror of the serve step's internal split (the key
+        # buffer itself is donated to the program); the train chain
+        # folds off the same sub-key so the two legs decorrelate
+        _, sub = jax.random.split(skey)
+        tkey = jax.random.fold_in(sub, 777)
+        nxt, logits = fserve(serve_params, skey, featf, None,
+                             dindptr_j, dindices_j, seeds)
+        jax.block_until_ready(logits)
+        # the walk the serve step just ran, fused vs split, bit-exact
+        g_nid, g_layers, g_x = fused_multihop(
+            dindptr_j, fidx, seeds, featf, dsizes, sub,
+            row_cap=fu_cap, rng="hash", interpret=True)
+        w_nid, w_layers, w_x = fused_multihop_reference(
+            dindptr_j, fidx, seeds, featf, dsizes, sub,
+            row_cap=fu_cap, rng="hash", interpret=True)
+        assert np.asarray(g_nid).tobytes() == \
+            np.asarray(w_nid).tobytes(), \
+            "fused frontier diverged from the split replay"
+        v = np.asarray(g_nid) >= 0
+        assert np.asarray(g_x)[v].tobytes() == \
+            np.asarray(w_x)[v].tobytes(), \
+            "fused rows diverged from the split replay"
+        return nxt, seeds, tkey
+
+    # warmup: compile all four programs (fused step, oracle step,
+    # fused serve, the standalone walk pair) and let the serve step's
+    # donated key buffer settle its placement (uncommitted -> steady
+    # donation chain takes a few dispatches, same as phase 14)
+    skey = jax.random.key(21)
+    for _ in range(3):
+        skey, wseeds, wtkey = f_iter(skey, st_o.params)
+    st_f, _ = fstep(st_f, featf, None, dindptr_j, dindices_j, wseeds,
+                    flabels[wseeds], wtkey)
+    st_o, _ = f_oracle(st_o, wseeds, wtkey)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    f_fns = (list(fstep.jitted_fns) + list(fserve.jitted_fns)
+             + [_fz._multihop_impl])
+    base_cache = sum(f._cache_size() for f in f_fns)
+
+    for i in range(50):
+        skey, seeds, tkey = f_iter(skey, st_o.params)
+        st_f, loss_f = fstep(st_f, featf, None, dindptr_j, dindices_j,
+                             seeds, flabels[seeds], tkey)
+        st_o, loss_o = f_oracle(st_o, seeds, tkey)
+        assert np.asarray(loss_f).tobytes() == \
+            np.asarray(loss_o).tobytes(), \
+            f"fused loss diverged from the split replay at step {i}"
+    for a, b in zip(jax.tree_util.tree_leaves(st_f.params),
+                    jax.tree_util.tree_leaves(st_o.params)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), \
+            "fused params drifted from the split replay after 50 steps"
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = sum(f._cache_size() for f in f_fns) - base_cache
+    print(f"phase 15 live arrays: {base_arrays} -> {arrays}; "
+          f"fused multi-hop executable-cache growth: {grew}")
+    assert grew == 0, \
+        "fused multi-hop walk recompiled mid-loop (shape/key leak)"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak across fused multi-hop train+serve steps"
+    print("no leak detected (phase 15: 50 fused multi-hop train+serve "
+          "steps, losses and rows bit-identical to the split replay)")
+
 
 if __name__ == "__main__":
     main()

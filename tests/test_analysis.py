@@ -480,7 +480,7 @@ class TestCleanPass:
         assert errors == []
         assert set(ran) >= {"train_step", "lookup_tiered",
                             "dist_lookup", "serve_step",
-                            "fused_hot_hop"}
+                            "fused_hot_hop", "fused_multihop"}
 
     def test_fused_hot_hop_entry(self):
         # the fused sample+gather kernel's contract, as cost-model
@@ -497,6 +497,23 @@ class TestCleanPass:
         assert fused_cost.gather_bytes > 0       # real DMA traffic
         split_cost = cost_of(registry.build_entry("train_step"))
         assert split_cost.gather_index_bytes == 2080
+        findings = run_rules(specs[0], ("no_host_sync",))
+        assert [str(f) for f in findings] == []
+
+    def test_fused_multihop_entry(self):
+        # qt-fuse-deep: the WHOLE fanout walk — interior sampling-only
+        # hops, leaf sample+gather, compaction, reassembly — still
+        # models ZERO gather indexing bytes (in-kernel indptr at every
+        # hop; the split train step's per-hop frontier round trips
+        # price at 2080 B), while the leaf's tier DMAs show up as real
+        # gather traffic
+        specs = registry.build_entry_specs("fused_multihop")
+        assert len(specs) == specs[0].census.count() == 2
+        from quiver_tpu.analysis.costmodel import cost_of
+        for spec in specs:
+            c = cost_of(spec)
+            assert c.gather_index_bytes == 0, spec.name
+            assert c.gather_bytes > 0, spec.name
         findings = run_rules(specs[0], ("no_host_sync",))
         assert [str(f) for f in findings] == []
 
