@@ -9,7 +9,7 @@
 # Usage: sh benchmarks/chip_suite.sh [section ...]
 #   sections: verify prof fleet chaos bench dispatch sampler gather
 #             tiered offload io e2e exchange mixed hetero micro
-#             ablate regress
+#             ablate capacity regress
 #   default       = every section
 #   quick         = bench only (the metric of record; also warms the
 #                   compile cache for a later full sweep)
@@ -25,7 +25,7 @@ export QT_METRICS_JSONL
 SUITE_T0=$(date +%s)
 . benchmarks/_suite_common.sh
 
-SECTIONS="${*:-verify prof fleet chaos trace bench dispatch sampler fuse gather tiered offload io e2e exchange mixed hetero micro ablate regress}"
+SECTIONS="${*:-verify prof fleet chaos trace bench dispatch sampler fuse gather tiered offload io e2e exchange mixed hetero micro ablate capacity regress}"
 [ "$SECTIONS" = "quick" ] && SECTIONS="bench"
 
 want() {
@@ -203,6 +203,21 @@ fi
 # fused-epoch stage ablation (how much of a batch is compaction?)
 if want ablate; then
     step python -u benchmarks/ablate.py
+fi
+
+# replay-verified capacity (qt-capacity): calibrate the capacity
+# model on this box, predict the sustainable rate of the default
+# tenant mix, then PROVE it — a trace-replay search for the measured
+# sustained rate (±25% gate) plus the 10x best-effort flash-crowd
+# flood gate (interactive p99 within SLO while best_effort absorbs
+# the shed). CPU-only replay smoke (never claims the chip); the
+# capacity record + verdict land in QT_METRICS_JSONL, and the
+# non-smoke capacity_abs_err_frac is a lower-is-better trajectory
+# group the final regress section judges. The capacity report renders
+# from the record just emitted.
+if want capacity; then
+    step env JAX_PLATFORMS=cpu python -u benchmarks/bench_capacity.py --smoke
+    step env JAX_PLATFORMS=cpu python -u scripts/qt_capacity.py --jsonl "$QT_METRICS_JSONL" --no-color
 fi
 
 # regression sentinel, LAST: judge the records THIS sweep mirrored to

@@ -54,8 +54,10 @@ from .datasets import (GraphDataset, from_numpy_dir,
 from .pipeline import Pipeline, pipelined
 from .metrics import Collector, MetricsSink, SloBudget, StepStats
 from .serving import (MicroBatchServer, OverloadError, ServeConfig,
-                      ServeEngine, ShardedServeEngine,
-                      build_serve_step, build_sharded_serve_step)
+                      ServeEngine, ShardedServeEngine, TenantClass,
+                      build_serve_step, build_sharded_serve_step,
+                      default_tenant_classes)
+from .traffic import generate_scenario, replay
 from .tailsampling import TailSampler, TraceStore
 from .telemetry import FlightRecorder, PlanContext, TelemetryHub
 from .profile import StageProfiler, machine_probe
@@ -65,9 +67,10 @@ from .actuator import Actuator, FleetAutoscaler, Knob
 from .faults import FaultPlan, FaultRule
 from .rpc import (RpcClient, RpcError, RpcServer, DeadlineExceeded,
                   ServerClosed)
-from . import (actuator, analysis, comm, profiling, checkpoint,
-               datasets, debug, faults, fleet, metrics, profile, rpc,
-               serving, tailsampling, telemetry, tracing)
+from . import (actuator, analysis, capacity, comm, profiling,
+               checkpoint, datasets, debug, faults, fleet, metrics,
+               profile, rpc, serving, tailsampling, telemetry, tracing,
+               traffic)
 
 # torch-quiver compatible aliases (reference __init__.py exports these names)
 p2pCliqueTopo = Topo
@@ -136,8 +139,12 @@ __all__ = [
     "ServeConfig",
     "ServeEngine",
     "ShardedServeEngine",
+    "TenantClass",
+    "default_tenant_classes",
     "build_serve_step",
     "build_sharded_serve_step",
+    "generate_scenario",
+    "replay",
     "TailSampler",
     "TraceStore",
     "TelemetryHub",

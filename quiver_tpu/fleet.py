@@ -124,6 +124,7 @@ class _Replica:
                                 watches=())
         self.meta: Optional[dict] = None
         self.last_serving: Optional[dict] = None
+        self.tenants: dict = {}   # latest `tenant` record per class
         self.records = 0          # kind-matching records ever folded
         self.last_new: Optional[float] = None   # clock of last advance
         self.stale = False
@@ -227,6 +228,10 @@ class FleetAggregator:
                                     "replica") if k in rec}
             elif kind == "serving":
                 r.last_serving = rec
+            elif kind == "tenant" and rec.get("tenant"):
+                # latest record per tenant class — the per-tenant
+                # counters are cumulative, so newest wins
+                r.tenants[rec["tenant"]] = rec
             elif kind == "trace":
                 # TraceStore.add dedups by (source, root), so the
                 # whole-file re-read every poll folds each kept trace
@@ -327,6 +332,22 @@ class FleetAggregator:
                 "partition": serving.get("partition"),
                 "locality_hit_rate": derived.get("locality_hit_rate"),
             }
+            if r.tenants:
+                # per-tenant accounting plane (qt-capacity): the
+                # newest per-class record, condensed to the fields the
+                # fleet view + Prometheus export pivot on
+                reps[r.name]["tenants"] = {
+                    name: {
+                        "priority": t.get("priority"),
+                        "requests": t.get("requests"),
+                        "completed": t.get("completed"),
+                        "rejected": t.get("rejected"),
+                        "shed": t.get("shed"),
+                        "p99_ms": (t.get("latency") or {}).get("p99_ms"),
+                        "burn": ((t.get("slo") or {}).get("windows", {})
+                                 .get("short", {}).get("burn_rate")),
+                    }
+                    for name, t in sorted(r.tenants.items())}
         healths = [v["health"] for v in reps.values()]
         n_stale = sum(1 for v in reps.values() if v["stale"])
         if n_stale == len(reps):
@@ -1156,6 +1177,40 @@ def _prometheus_text_ex(agg: FleetAggregator) -> Tuple[str, bool]:
              "Aggregation passes completed.")):
         head(metric, typ, help_)
         lines.append(f"{metric} {_fmt_value(fl[key])}")
+
+    # per-tenant accounting plane (qt-capacity): one sample per
+    # (replica, tenant-class), straight off each replica's newest
+    # `tenant` record — tenant names ride in a label, same discipline
+    # as series names, so arbitrary registry names stay valid
+    tenant_metrics = (
+        ("qt_tenant_requests_total", "counter", "requests",
+         "Requests admitted for the tenant class."),
+        ("qt_tenant_completed_total", "counter", "completed",
+         "Requests completed for the tenant class."),
+        ("qt_tenant_rejected_total", "counter", "rejected",
+         "Requests rejected at admission for the tenant class."),
+        ("qt_tenant_shed_total", "counter", "shed",
+         "Requests turned away for the tenant class (rejected + "
+         "displaced + deadline-expired)."),
+        ("qt_tenant_p99_ms", "gauge", "p99_ms",
+         "Per-tenant request latency p99 (milliseconds)."),
+        ("qt_tenant_burn_rate", "gauge", "burn",
+         "Per-tenant SLO short-window error-budget burn rate."),
+    )
+    for metric, typ, key, help_ in tenant_metrics:
+        samples = []
+        for rname, r in snap["replicas"].items():
+            for tname, t in (r.get("tenants") or {}).items():
+                val = t.get(key)
+                if val is None:
+                    continue
+                samples.append(
+                    f'{metric}{{replica="{_prom_escape(rname)}",'
+                    f'tenant="{_prom_escape(tname)}"}} '
+                    f'{_fmt_value(val)}')
+        if samples:
+            head(metric, typ, help_)
+            lines.extend(samples)
 
     head("qt_series", "gauge",
          "Last value of each telemetry series (no replica label = "

@@ -199,12 +199,14 @@ class RpcServer:
     """Asyncio front end over one serve backend.
 
     ``backend`` is duck-typed: ``submit(node_id, context=None[,
-    deadline=None]) -> concurrent.futures.Future`` (the
+    deadline=None][, tenant=None]) -> concurrent.futures.Future`` (the
     ``MicroBatchServer`` contract; ``deadline`` — an absolute
     ``time.perf_counter()`` instant — is passed when the signature
     takes it, so the coalescer can shed expired work before it costs a
-    batch slot) plus optional ``health() -> {"score": float, ...}``
-    for ``ping``. The loop runs on a daemon thread; ``port=0`` binds
+    batch slot; ``tenant`` — a tenant-class name from the request's
+    ``tenant`` wire field — likewise, so per-tenant SLO accounting and
+    shed-order policy apply fleet-wide) plus optional ``health() ->
+    {"score": float, ...}`` for ``ping``. The loop runs on a daemon thread; ``port=0`` binds
     ephemeral (read ``.port`` back). ``close()`` is idempotent.
 
     Each accepted request passes the ``rpc.request`` fault site —
@@ -224,10 +226,12 @@ class RpcServer:
         self.shed_deadline = 0
         try:
             import inspect
-            self._takes_deadline = "deadline" in \
-                inspect.signature(backend.submit).parameters
+            params = inspect.signature(backend.submit).parameters
+            self._takes_deadline = "deadline" in params
+            self._takes_tenant = "tenant" in params
         except (TypeError, ValueError):
             self._takes_deadline = False
+            self._takes_tenant = False
         if start:
             self.start()
 
@@ -392,6 +396,11 @@ class RpcServer:
             kw = {"context": msg.get("ctx")}
             if self._takes_deadline:
                 kw["deadline"] = deadline
+            if self._takes_tenant and msg.get("tenant") is not None:
+                # tenant rides the wire as plain request metadata; a
+                # backend without a registry (no `tenant` parameter)
+                # simply never sees it
+                kw["tenant"] = str(msg["tenant"])
             fut = self.backend.submit(int(msg["node"]), **kw)
         except BaseException as e:
             name, text = _wire_error_of(e)
@@ -643,18 +652,19 @@ class RpcClient:
                             ctx: Optional[dict],
                             timeout_s: float,
                             tid: Optional[int] = None,
-                            hedge: bool = False) -> np.ndarray:
+                            hedge: bool = False,
+                            tenant: Optional[str] = None) -> np.ndarray:
         # with tracing on, each dispatch leaves an `rpc.attempt` (or
         # `rpc.hedge`) span under the request's trace_id — retries and
         # hedge races are visible per replica in the assembled trace
         if tid is None:
             return await self._call_replica_raw(name, node, budget_ms,
-                                                ctx, timeout_s)
+                                                ctx, timeout_s, tenant)
         t0 = time.perf_counter()
         span = "rpc.hedge" if hedge else "rpc.attempt"
         try:
             row = await self._call_replica_raw(name, node, budget_ms,
-                                               ctx, timeout_s)
+                                               ctx, timeout_s, tenant)
         except asyncio.CancelledError:
             # a cancelled hedge loser is NOT an outcome — the winner's
             # span tells the request's story; recording
@@ -673,13 +683,17 @@ class RpcClient:
     async def _call_replica_raw(self, name: str, node: int,
                                 budget_ms: Optional[float],
                                 ctx: Optional[dict],
-                                timeout_s: float) -> np.ndarray:
+                                timeout_s: float,
+                                tenant: Optional[str] = None
+                                ) -> np.ndarray:
         conn = await self._conn_of(name)
         msg = {"op": "lookup", "id": next(self._ids), "node": int(node)}
         if budget_ms is not None:
             msg["budget_ms"] = round(float(budget_ms), 3)
         if ctx:
             msg["ctx"] = ctx
+        if tenant is not None:
+            msg["tenant"] = str(tenant)
         try:
             resp = await conn.call(msg, timeout_s)
         except asyncio.TimeoutError:
@@ -696,7 +710,8 @@ class RpcClient:
                        ctx: Optional[dict],
                        causes: List[BaseException],
                        dispatched: List[str],
-                       tid: Optional[int] = None) -> np.ndarray:
+                       tid: Optional[int] = None,
+                       tenant: Optional[str] = None) -> np.ndarray:
         """One attempt = a primary call plus (optionally) one hedge to
         the next-ranked replica once the hedge delay passes unanswered.
         First answer wins; the loser is cancelled (idempotent serve
@@ -708,7 +723,8 @@ class RpcClient:
         if remaining_ms is not None:
             timeout_s = min(timeout_s, max(remaining_ms, 1.0) / 1e3)
         primary = asyncio.ensure_future(self._call_replica(
-            names[0], node, remaining_ms, ctx, timeout_s, tid))
+            names[0], node, remaining_ms, ctx, timeout_s, tid,
+            tenant=tenant))
         dispatched.append(names[0])
         tasks = {primary: names[0]}
         if self.hedge and len(names) > 1:
@@ -721,7 +737,8 @@ class RpcClient:
                            else max(remaining_ms - delay * 1e3, 1.0))
                 hedge = asyncio.ensure_future(self._call_replica(
                     names[1], node, left_ms, ctx,
-                    max(timeout_s - delay, 1e-3), tid, hedge=True))
+                    max(timeout_s - delay, 1e-3), tid, hedge=True,
+                    tenant=tenant))
                 dispatched.append(names[1])
                 tasks[hedge] = names[1]
         pending = set(tasks)
@@ -746,9 +763,11 @@ class RpcClient:
         raise causes[-1]
 
     async def _lookup(self, node: int, budget_ms: Optional[float],
-                      ctx: Optional[dict]) -> np.ndarray:
+                      ctx: Optional[dict],
+                      tenant: Optional[str] = None) -> np.ndarray:
         if not tracing.enabled():
-            return await self._lookup_inner(node, budget_ms, ctx, None)
+            return await self._lookup_inner(node, budget_ms, ctx, None,
+                                            tenant)
         # the client's ROOT span (`rpc.lookup`) closes the trace on
         # this side of the wire — the tail sampler's completion
         # signal; a failed lookup closes it error-stamped, so the
@@ -757,7 +776,8 @@ class RpcClient:
         tid = c.trace_id if c is not None else tracing.new_global_trace_id()
         t0 = time.perf_counter()
         try:
-            row = await self._lookup_inner(node, budget_ms, ctx, tid)
+            row = await self._lookup_inner(node, budget_ms, ctx, tid,
+                                           tenant)
         except asyncio.CancelledError:
             # a cancelled lookup (caller cancelled the future, client
             # shutting down) is NOT a failed request — no root span,
@@ -774,7 +794,8 @@ class RpcClient:
 
     async def _lookup_inner(self, node: int, budget_ms: Optional[float],
                             ctx: Optional[dict],
-                            tid: Optional[int]) -> np.ndarray:
+                            tid: Optional[int],
+                            tenant: Optional[str] = None) -> np.ndarray:
         t0 = time.perf_counter()
         deadline = (None if budget_ms is None
                     else t0 + float(budget_ms) / 1e3)
@@ -799,7 +820,8 @@ class RpcClient:
             dispatched: List[str] = []
             try:
                 row = await self._attempt(names, node, remaining_ms,
-                                          ctx, causes, dispatched, tid)
+                                          ctx, causes, dispatched, tid,
+                                          tenant)
                 with self._lock:
                     self._lat_ms.append(
                         (time.perf_counter() - t0) * 1e3)
@@ -838,10 +860,14 @@ class RpcClient:
 
     # -- the sync facade ------------------------------------------------------
     def lookup_future(self, node: int, budget_ms: Optional[float] = None,
-                      context: Optional[dict] = None):
+                      context: Optional[dict] = None,
+                      tenant: Optional[str] = None):
         """Submit one lookup; returns a ``concurrent.futures.Future``
         resolving to the float32 logits row or raising a typed
-        :class:`RpcError`."""
+        :class:`RpcError`. ``tenant`` (a tenant-class name) rides the
+        wire as request metadata — replicas with a tenant registry
+        apply their per-tenant SLO accounting + shed-order policy;
+        replicas without one ignore it."""
         if self._closed:
             raise ServerClosed("rpc client is closed")
         if tracing.enabled():
@@ -858,10 +884,12 @@ class RpcClient:
         with self._lock:
             self._stats["requests"] += 1
         return asyncio.run_coroutine_threadsafe(
-            self._lookup(int(node), budget_ms, context), self._loop)
+            self._lookup(int(node), budget_ms, context, tenant),
+            self._loop)
 
     def lookup(self, node: int, budget_ms: Optional[float] = None,
-               context: Optional[dict] = None) -> np.ndarray:
+               context: Optional[dict] = None,
+               tenant: Optional[str] = None) -> np.ndarray:
         """Blocking :meth:`lookup_future`."""
         timeout = None
         if budget_ms is not None:
@@ -869,8 +897,8 @@ class RpcClient:
             # deadline; this only stops a wedged loop from hanging the
             # caller forever
             timeout = budget_ms / 1e3 + 30.0
-        return self.lookup_future(node, budget_ms, context).result(
-            timeout=timeout)
+        return self.lookup_future(node, budget_ms, context,
+                                  tenant).result(timeout=timeout)
 
     def ping(self, name: str, timeout_ms: float = 1000.0) -> dict:
         """One ``ping`` to a named replica (health probe)."""

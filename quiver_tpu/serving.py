@@ -60,6 +60,25 @@ instead of queueing unbounded work). ``snapshot()`` is one
 JSONL-ready record (kind ``serving``, with an ``slo`` block when a
 budget is configured).
 
+Tenancy (qt-capacity) is an OPTIONAL fourth layer over the same
+machinery: a ``{name: TenantClass}`` registry (see
+``default_tenant_classes`` — interactive / batch / best_effort) makes
+``submit(tenant=)`` file every request under an SLO class, and shed
+order becomes POLICY instead of arrival luck. Load shed consumes
+best-effort first (weighted admission shares under pressure, plus a
+full queue displaces the newest lowest-priority queued request to
+admit a higher-priority one — never the reverse); quality shed
+consumes best-effort first too (under a shed episode batches coalesce
+class-pure and each class ignores ``shed_grace`` ladder steps, so
+interactive degrades last). Per-tenant accounting — request
+histograms, burn/shed/reject counts, an optional per-class
+``SloBudget`` — lands as the ``tenant`` JSONL kind
+(``emit_tenants``). Tenancy is host-side queue discipline +
+accounting only: it never touches the seed block or the compiled
+programs, so logits are bit-identical with accounting on or off
+(pinned in tests/test_traffic.py) and the executable cache stays flat
+(``scripts/check_leak.py`` phase 16).
+
 With ``quiver_tpu.tracing`` enabled every request leaves a span
 timeline: per-request ``serve.admission_wait`` / ``serve.coalesce_wait``
 / ``serve.request`` spans (each stamped with its own ``trace_id`` AND
@@ -106,6 +125,115 @@ class OverloadError(RuntimeError):
     that WERE admitted before the queue filled (they still run)."""
 
     futures: Sequence = ()
+
+
+# -- tenancy: per-tenant SLO classes (qt-capacity) ---------------------------
+
+
+#: the built-in tenant SLO classes, highest priority first; shed order
+#: is the REVERSE of this tuple (best_effort absorbs load- and
+#: quality-shed first, interactive last). Pinned against
+#: docs/observability.md by scripts/lint.sh.
+TENANT_CLASS_NAMES = ("interactive", "batch", "best_effort")
+
+
+class TenantClass:
+    """One tenant SLO class — the unit of multi-tenant accounting and
+    shed policy in :class:`MicroBatchServer`.
+
+    - ``priority``: admission displacement order. A full queue evicts
+      the newest queued request of the lowest priority STRICTLY below
+      the arriving request's, never the reverse — so interactive
+      admission consumes best-effort queue slots under overload.
+    - ``admission_weight``: the class's guaranteed share of the
+      admission queue. Under pressure (queue past the shed threshold)
+      a class already holding its weighted share is rejected at the
+      door while under-share classes still admit — a best-effort flood
+      cannot starve interactive admission.
+    - ``shed_grace``: how many quality-shed ladder steps this class's
+      batches ignore. Grace 0 (best_effort) degrades at the first shed
+      step; a grace at least the ladder depth (interactive's default)
+      degrades only under a fleet-planned floor — quality shed
+      consumes best-effort first, interactive last.
+    - ``slo_p99_ms`` (+ the ``slo_*`` shape knobs): arms a per-class
+      ``metrics.SloBudget`` for burn accounting. The SERVER's
+      aggregate budget still drives the shed trigger; the per-class
+      budget is the accounting the ``tenant`` JSONL kind reports.
+    """
+
+    def __init__(self, name: str, priority: int,
+                 admission_weight: float = 1.0, shed_grace: int = 0,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_availability: float = 0.99,
+                 slo_window_s: float = 300.0,
+                 slo_short_window_s: float = 30.0):
+        if not name:
+            raise ValueError("tenant class needs a name")
+        if not admission_weight > 0.0:
+            raise ValueError(
+                f"admission_weight must be > 0, got {admission_weight}")
+        if shed_grace < 0:
+            raise ValueError(f"shed_grace must be >= 0, got {shed_grace}")
+        self.name = str(name)
+        self.priority = int(priority)
+        self.admission_weight = float(admission_weight)
+        self.shed_grace = int(shed_grace)
+        self.slo_p99_ms = (None if slo_p99_ms is None
+                           else float(slo_p99_ms))
+        self.slo_availability = float(slo_availability)
+        self.slo_window_s = float(slo_window_s)
+        self.slo_short_window_s = float(slo_short_window_s)
+
+    def make_budget(self):
+        """A fresh per-class ``metrics.SloBudget`` (None when this
+        class declares no latency target)."""
+        from .metrics import SloBudget
+        if self.slo_p99_ms is None:
+            return None
+        return SloBudget(self.slo_p99_ms,
+                         availability=self.slo_availability,
+                         window_s=self.slo_window_s,
+                         short_window_s=self.slo_short_window_s)
+
+
+def default_tenant_classes(slo_p99_ms: Optional[float] = None) -> dict:
+    """The standard three-class registry (``TENANT_CLASS_NAMES``):
+    interactive (priority 2, 4x admission weight, never quality-shed
+    before the ladder is exhausted, SLO target ``slo_p99_ms``), batch
+    (priority 1, 2x weight, one step of grace, 4x the latency target),
+    best_effort (priority 0, weight 1, no grace, no latency target —
+    it absorbs the shed). Pass the dict to
+    ``MicroBatchServer(tenants=...)``."""
+    return {
+        "interactive": TenantClass(
+            "interactive", priority=2, admission_weight=4.0,
+            shed_grace=8, slo_p99_ms=slo_p99_ms),
+        "batch": TenantClass(
+            "batch", priority=1, admission_weight=2.0, shed_grace=1,
+            slo_p99_ms=(4.0 * slo_p99_ms if slo_p99_ms is not None
+                        else None)),
+        "best_effort": TenantClass(
+            "best_effort", priority=0, admission_weight=1.0,
+            shed_grace=0),
+    }
+
+
+class _TenantState:
+    """Per-class accounting the server keeps under ``_counts_lock``
+    (except ``budget``, which locks itself)."""
+
+    __slots__ = ("cls", "budget", "hist", "counts", "queued", "share")
+
+    def __init__(self, cls: TenantClass, share: int):
+        from .metrics import _Histogram
+        self.cls = cls
+        self.budget = cls.make_budget()
+        self.hist = _Histogram()
+        self.queued = 0
+        self.share = share
+        self.counts = {"requests": 0, "completed": 0, "rejected": 0,
+                       "displaced": 0, "deadline_expired": 0,
+                       "failed": 0}
 
 
 # -- the jitted serve step ---------------------------------------------------
@@ -769,15 +897,18 @@ def _fail_future(fut, exc) -> bool:
 
 
 class _Request:
-    __slots__ = ("node_id", "future", "t_enq", "trace_id", "deadline")
+    __slots__ = ("node_id", "future", "t_enq", "trace_id", "deadline",
+                 "tenant")
 
     def __init__(self, node_id: int, future, t_enq: float,
-                 trace_id=None, deadline: Optional[float] = None):
+                 trace_id=None, deadline: Optional[float] = None,
+                 tenant: Optional[str] = None):
         self.node_id = node_id
         self.future = future
         self.t_enq = t_enq
         self.trace_id = trace_id
         self.deadline = deadline
+        self.tenant = tenant
 
 
 class MicroBatchServer:
@@ -797,7 +928,8 @@ class MicroBatchServer:
 
     def __init__(self, engine: ServeEngine,
                  config: Optional[ServeConfig] = None,
-                 stats=None, start: bool = True, hub=None):
+                 stats=None, start: bool = True, hub=None,
+                 tenants: Optional[dict] = None):
         from .metrics import SloBudget, StepStats, register_report_section
         from .pipeline import Pipeline
         self.engine = engine
@@ -822,6 +954,38 @@ class MicroBatchServer:
                                  window_s=cfg.slo_window_s,
                                  short_window_s=cfg.slo_short_window_s,
                                  shed_burn_rate=cfg.shed_burn_rate)
+        # tenancy (qt-capacity): OPTIONAL {name: TenantClass} registry.
+        # None (the default) disables the whole plane; with a registry,
+        # every request files under a class (None tenant -> the
+        # lowest-priority class) and shed ORDER becomes policy — see
+        # the module docstring. Tenancy is host-side accounting + queue
+        # discipline only: it never changes the seed block or which
+        # programs compile.
+        self._tenants: Optional[dict] = None
+        self._tenant_default: Optional[str] = None
+        self._tenant_states: dict = {}
+        # requests popped by the coalescer but deferred to a later
+        # batch (class-pure coalescing under a shed episode);
+        # coalescer-thread-only, swept by close()/the death watchdog
+        self._held: list = []
+        if tenants:
+            reg = dict(tenants)
+            for n, c in reg.items():
+                if not isinstance(c, TenantClass):
+                    raise TypeError(
+                        f"tenants[{n!r}] must be a TenantClass")
+                if n != c.name:
+                    raise ValueError(
+                        f"tenant registry key {n!r} names a class "
+                        f"called {c.name!r}")
+            self._tenants = reg
+            self._tenant_default = min(
+                reg, key=lambda n: (reg[n].priority, n))
+            wsum = sum(c.admission_weight for c in reg.values())
+            for n, c in reg.items():
+                share = max(1, int(np.ceil(
+                    cfg.queue_depth * c.admission_weight / wsum)))
+                self._tenant_states[n] = _TenantState(c, share)
         self._q: "queue.Queue[_Request]" = queue.Queue(
             maxsize=self.config.queue_depth)
         self._pipe = Pipeline(depth=self.config.pipeline_depth,
@@ -847,7 +1011,7 @@ class MicroBatchServer:
         self._shed_floor = 0
         self._counts = {
             "requests": 0, "rejected": 0, "completed": 0, "failed": 0,
-            "deadline_expired": 0,
+            "deadline_expired": 0, "displaced": 0,
             "batches": 0, "coalesced": 0,
             "variant_batches": [0] * len(engine.variants),
         }
@@ -889,7 +1053,10 @@ class MicroBatchServer:
         if t is not None and t is not threading.current_thread():
             t.join()
         # the coalescer is gone: anything still queued will never run
-        undispatched = []
+        # (held requests — popped but deferred by class-pure
+        # coalescing — are safe to sweep here: the thread is joined)
+        undispatched = list(self._held)
+        self._held = []
         while True:
             try:
                 undispatched.append(self._q.get_nowait())
@@ -912,8 +1079,52 @@ class MicroBatchServer:
         return self._closed
 
     # -- admission ----------------------------------------------------------
+    def _account_shed(self, tenant: Optional[str], key: str) -> None:
+        """File one shed outcome (admission ``rejected``,
+        ``displaced``, or ``deadline_expired``) into the aggregate
+        counters, the aggregate SLO budget, and the owning tenant's
+        accounting — one helper so load shed, displacement and
+        deadline shed can never drift apart."""
+        if self.slo is not None:
+            # a shed request is an availability miss — the budget
+            # must see it (the old raw-p99 trigger never did)
+            self.slo.record(ok=False)
+        st = self._tenant_states.get(tenant) if tenant else None
+        with self._counts_lock:
+            self._counts[key] += 1
+            if st is not None:
+                st.counts[key] += 1
+        if st is not None and st.budget is not None:
+            st.budget.record(ok=False)
+
+    def _displace_for(self, priority: int):
+        """Queue-discipline load shed: evict the NEWEST queued request
+        of the lowest priority STRICTLY below ``priority`` to make
+        room for a higher-priority admission (tenancy only). The
+        victim's future fails with :class:`OverloadError` and its
+        class absorbs the shed. Returns True when a slot was freed."""
+        q = self._q
+        with q.mutex:
+            best_i, best_p = -1, priority
+            for i in range(len(q.queue) - 1, -1, -1):
+                p = self._tenants[q.queue[i].tenant].priority
+                if p < best_p:
+                    best_i, best_p = i, p
+            if best_i < 0:
+                return False
+            victim = q.queue[best_i]
+            del q.queue[best_i]
+            q.not_full.notify()
+        with self._counts_lock:
+            self._tenant_states[victim.tenant].queued -= 1
+        if _fail_future(victim.future, OverloadError(
+                "displaced at admission by a higher-priority tenant")):
+            self._account_shed(victim.tenant, "displaced")
+        return True
+
     def submit(self, node_id: int, context=None,
-               deadline: Optional[float] = None):
+               deadline: Optional[float] = None,
+               tenant: Optional[str] = None):
         """Admit one point query; returns a ``Future`` resolving to the
         node's logits row (numpy ``[out_dim]``). Raises
         :class:`OverloadError` IMMEDIATELY when the admission queue is
@@ -938,11 +1149,29 @@ class MicroBatchServer:
         ``trace_id`` instead of a locally minted one, so the client's
         and this replica's exported traces correlate in one merged
         Perfetto view (``tracing.merge_chrome_traces``). A missing or
-        mangled context falls back to a local id — never an error."""
+        mangled context falls back to a local id — never an error.
+
+        ``tenant`` names the request's :class:`TenantClass` when the
+        server was built with a registry (``tenants=``): the request
+        files under that class's accounting and shed policy (a
+        ``None`` tenant lands in the lowest-priority class; an
+        unregistered name raises ``ValueError``). Without a registry
+        the argument is accepted and ignored — RPC front ends thread
+        it through unconditionally."""
         if self._closed or self._broken:
             raise ServerClosed("server is closed"
                                if self._closed else
                                "server is broken (coalescer died)")
+        tname = None
+        st = None
+        if self._tenants is not None:
+            tname = tenant if tenant is not None else \
+                self._tenant_default
+            st = self._tenant_states.get(tname)
+            if st is None:
+                raise ValueError(
+                    f"unknown tenant class {tname!r} (registered: "
+                    f"{sorted(self._tenants)})")
         from concurrent.futures import Future
         fut: Future = Future()
         tid = None
@@ -952,19 +1181,40 @@ class MicroBatchServer:
             tid = ctx.trace_id if ctx is not None \
                 else tracing.new_trace_id()
         req = _Request(int(node_id), fut, time.perf_counter(), tid,
-                       deadline)
+                       deadline, tname)
+        cfg = self.config
+        if st is not None:
+            # weighted admission shares, enforced only under pressure
+            # (queue past the shed threshold): a class already holding
+            # its share of the queue is rejected at the door while
+            # under-share classes still admit — load shed consumes the
+            # flooding class first, and a calm queue never rejects
+            shed_at = max(1, int(cfg.queue_depth * cfg.shed_queue_frac))
+            if self._q.qsize() >= shed_at and st.queued >= st.share:
+                self._account_shed(tname, "rejected")
+                raise OverloadError(
+                    f"admission queue pressed and tenant {tname!r} "
+                    f"holds its share ({st.share}); request shed")
         try:
             self._q.put_nowait(req)
         except queue.Full:
-            with self._counts_lock:
-                self._counts["rejected"] += 1
-            if self.slo is not None:
-                # a shed request is an availability miss — the budget
-                # must see it (the old raw-p99 trigger never did)
-                self.slo.record(ok=False)
-            raise OverloadError(
-                f"admission queue full ({self.config.queue_depth} "
-                "pending); request shed") from None
+            # tenancy: a full queue displaces the newest queued
+            # request of a strictly lower priority before giving up —
+            # interactive admission consumes best-effort slots, never
+            # the reverse (one retry; a lost race with another
+            # submitter degrades to an honest reject)
+            admitted = False
+            if st is not None and self._displace_for(st.cls.priority):
+                try:
+                    self._q.put_nowait(req)
+                    admitted = True
+                except queue.Full:
+                    pass
+            if not admitted:
+                self._account_shed(tname, "rejected")
+                raise OverloadError(
+                    f"admission queue full ({cfg.queue_depth} "
+                    "pending); request shed") from None
         if self._closed or self._broken:
             # close() (or the coalescer-death watchdog) raced us: its
             # drain may have run before our put landed, and no
@@ -976,10 +1226,14 @@ class MicroBatchServer:
             raise ServerClosed("server is closed")
         with self._counts_lock:
             self._counts["requests"] += 1
+            if st is not None:
+                st.counts["requests"] += 1
+                st.queued += 1
         return fut
 
     def submit_many(self, node_ids, context=None,
-                    deadline: Optional[float] = None) -> list:
+                    deadline: Optional[float] = None,
+                    tenant: Optional[str] = None) -> list:
         """``submit`` per id (one shared ``context`` — a multi-point
         client operation traces as ONE request id across its points).
         If admission overloads mid-list the raised
@@ -990,7 +1244,8 @@ class MicroBatchServer:
         for i in node_ids:
             try:
                 futs.append(self.submit(i, context=context,
-                                        deadline=deadline))
+                                        deadline=deadline,
+                                        tenant=tenant))
             except OverloadError as e:
                 e.futures = futs
                 raise
@@ -1062,7 +1317,8 @@ class MicroBatchServer:
             _log.error("serving coalescer died unexpectedly (%s: %s); "
                        "failing queued requests with ServerClosed",
                        type(e).__name__, e)
-            undispatched = []
+            undispatched = list(self._held)
+            self._held = []
             while True:
                 try:
                     undispatched.append(self._q.get_nowait())
@@ -1082,10 +1338,7 @@ class MicroBatchServer:
         if _fail_future(req.future, DeadlineExceeded(
                 "deadline passed while queued (shed at coalesce — the "
                 "client has already given up on this request)")):
-            if self.slo is not None:
-                self.slo.record(ok=False)
-            with self._counts_lock:
-                self._counts["deadline_expired"] += 1
+            self._account_shed(req.tenant, "deadline_expired")
             if tracing.enabled() and req.trace_id is not None:
                 # the request's TERMINAL span, error-stamped: a shed
                 # request still completes its trace, so the tail
@@ -1097,6 +1350,24 @@ class MicroBatchServer:
                                 "error": "DeadlineExceeded"})
         return True
 
+    def _note_popped(self, req) -> None:
+        """Per-tenant queued-count bookkeeping for one admission-queue
+        pop (weighted-share admission reads these counts)."""
+        if self._tenants is not None:
+            with self._counts_lock:
+                self._tenant_states[req.tenant].queued -= 1
+
+    def _pop_next(self, timeout: float):
+        """Next request for the coalescer: deferred (held) requests
+        first — oldest first, so class-pure deferral never starves a
+        class — then the admission queue. Raises ``queue.Empty`` on
+        timeout."""
+        if self._held:
+            return self._held.pop(0)
+        req = self._q.get(timeout=timeout)
+        self._note_popped(req)
+        return req
+
     def _coalesce_loop(self):
         while not self._closed:
             faults.fire("serve.coalesce")
@@ -1106,11 +1377,26 @@ class MicroBatchServer:
             max_wait = self._max_wait_s
             cap = min(self._fill_cap, self.engine.batch_cap)
             try:
-                first = self._q.get(timeout=0.02)
+                first = self._pop_next(0.02)
             except queue.Empty:
                 continue
             if self._shed_expired(first):
                 continue
+            # tenancy: under a shed episode batches coalesce
+            # CLASS-PURE (the batch takes only the first request's
+            # class; other classes defer to their own next batch), so
+            # the per-class shed_grace variant applies per batch —
+            # quality shed consumes best-effort first. Calm traffic
+            # (shed level 0, no floor) coalesces mixed: every class
+            # dispatches variant 0 there, so batch composition — and
+            # the logits — are unchanged by tenancy. The first batch
+            # of an episode (the one whose _select_variant call raises
+            # the level) is still mixed: the discipline lags pressure
+            # by exactly one batch.
+            bcls = None
+            if self._tenants is not None and (
+                    self._shed_level > 0 or self._shed_floor > 0):
+                bcls = self._tenants[first.tenant]
             # span plumbing: one enabled-check per batch when tracing is
             # off; when on, each request gets admission_wait (queue time
             # before the coalescer saw it) and coalesce_wait (time spent
@@ -1127,6 +1413,27 @@ class MicroBatchServer:
                                {"batch": bid, "node": first.node_id})
             batch = [first]
             slots = {first.node_id: 0}
+            if bcls is not None and self._held:
+                # sweep already-deferred requests of THIS class into
+                # the batch up front (one pass — the rest stay held)
+                keep = []
+                for r in self._held:
+                    if (len(slots) < cap
+                            and self._tenants[r.tenant] is bcls):
+                        if self._shed_expired(r):
+                            continue
+                        batch.append(r)
+                        slots.setdefault(r.node_id, len(slots))
+                        if traced:
+                            t_pop = time.perf_counter()
+                            pops.append((r, t_pop))
+                            tracing.record(
+                                "serve.admission_wait", r.t_enq,
+                                t_pop - r.t_enq, r.trace_id,
+                                {"batch": bid, "node": r.node_id})
+                    else:
+                        keep.append(r)
+                self._held = keep
             deadline = t_first + max_wait
             # drain until the seed block is full or the first request's
             # wait budget is spent — a lone request ships at deadline,
@@ -1136,10 +1443,21 @@ class MicroBatchServer:
                 if remaining <= 0:
                     break
                 try:
-                    req = self._q.get(timeout=remaining)
+                    if bcls is None:
+                        req = self._pop_next(remaining)
+                    else:
+                        # class-pure: pull from the queue only (held
+                        # was filtered above and now holds only other
+                        # classes — re-popping it here would spin)
+                        req = self._q.get(timeout=remaining)
+                        self._note_popped(req)
                 except queue.Empty:
                     break
                 if self._shed_expired(req):
+                    continue
+                if bcls is not None and \
+                        self._tenants[req.tenant] is not bcls:
+                    self._held.append(req)
                     continue
                 batch.append(req)
                 slots.setdefault(req.node_id, len(slots))
@@ -1156,6 +1474,14 @@ class MicroBatchServer:
             for nid, s in slots.items():
                 seeds[s] = nid
             variant = self._select_variant()
+            if bcls is not None:
+                # per-class quality-shed order: this class ignores
+                # shed_grace ladder steps of the local shed level; the
+                # fleet-planned floor still lower-bounds everyone
+                top = len(self.engine.variants) - 1
+                graced = max(0, min(self._shed_level, top)
+                             - bcls.shed_grace)
+                variant = max(graced, min(self._shed_floor, top))
             # the pipeline submit blocks at depth: device-side
             # backpressure propagates here, the queue absorbs it, and a
             # full queue sheds at admission — bounded everywhere
@@ -1205,7 +1531,9 @@ class MicroBatchServer:
             return 0
         cfg = self.config
         shed_at = max(1, int(cfg.queue_depth * cfg.shed_queue_frac))
-        pressed = self._q.qsize() >= shed_at
+        # held (class-deferred) requests are backlog too — they are
+        # admitted work the coalescer has not dispatched yet
+        pressed = self._q.qsize() + len(self._held) >= shed_at
         if not pressed and self.slo is not None:
             pressed = self.slo.should_shed()
         if pressed:
@@ -1230,11 +1558,13 @@ class MicroBatchServer:
         caller-side ``cancel()``; a future ``submit``'s close-race
         handler already failed counts as handled (``_fail_future``)."""
         failed = 0
+        failed_reqs = []
         traced = tracing.enabled()
         now = time.perf_counter() if traced else 0.0
         for req in batch:
             if _fail_future(req.future, exc_type(msg)):
                 failed += 1
+                failed_reqs.append(req)
                 if traced and req.trace_id is not None:
                     tracing.record("serve.request", req.t_enq,
                                    now - req.t_enq, req.trace_id,
@@ -1246,6 +1576,15 @@ class MicroBatchServer:
                     self.slo.record(ok=False)
             with self._counts_lock:
                 self._counts["failed"] += failed
+                for req in failed_reqs:
+                    st = self._tenant_states.get(req.tenant)
+                    if st is not None:
+                        st.counts["failed"] += 1
+            if self._tenants is not None:
+                for req in failed_reqs:
+                    st = self._tenant_states.get(req.tenant)
+                    if st is not None and st.budget is not None:
+                        st.budget.record(ok=False)
 
     def _execute(self, batch, slots, seeds, variant, bid=None):
         # claim every request's future up front: a caller-side cancel()
@@ -1272,6 +1611,15 @@ class MicroBatchServer:
                     self.slo.record(ok=False)
             with self._counts_lock:
                 self._counts["failed"] += len(batch)
+                for req in batch:
+                    st = self._tenant_states.get(req.tenant)
+                    if st is not None:
+                        st.counts["failed"] += 1
+            if self._tenants is not None:
+                for req in batch:
+                    st = self._tenant_states.get(req.tenant)
+                    if st is not None and st.budget is not None:
+                        st.budget.record(ok=False)
             if tracing.enabled():
                 # error-stamped terminal spans: the failed requests'
                 # traces complete with the outcome, so the tail
@@ -1312,11 +1660,21 @@ class MicroBatchServer:
             self.stats.record_request(lat)
             if self.slo is not None:
                 self.slo.record(lat)
+            if self._tenants is not None:
+                st = self._tenant_states.get(req.tenant)
+                if st is not None and st.budget is not None:
+                    st.budget.record(lat)
         with self._counts_lock:
             self._counts["completed"] += len(batch)
             self._counts["batches"] += 1
             self._counts["coalesced"] += len(batch)
             self._counts["variant_batches"][variant] += 1
+            if self._tenants is not None:
+                for req in batch:
+                    st = self._tenant_states.get(req.tenant)
+                    if st is not None:
+                        st.counts["completed"] += 1
+                        st.hist.add(done - req.t_enq)
         for req in batch:
             req.future.set_result(rows[slots[req.node_id]])
         if traced:
@@ -1398,6 +1756,59 @@ class MicroBatchServer:
         """Append :meth:`snapshot` to a ``metrics.MetricsSink``."""
         return sink.emit(self.snapshot(), kind=kind)
 
+    def tenant_snapshots(self) -> list:
+        """One JSONL-ready record per registered tenant class (kind
+        ``tenant``): the class declaration (priority, admission weight,
+        shed grace), the admission/outcome counters, the derived
+        ``shed`` total (rejected + displaced + deadline-expired — every
+        request the policy turned away), the per-tenant latency
+        histogram summary, and — when the class declares an SLO — its
+        ``SloBudget`` block. Empty list when no registry was
+        configured, so callers can emit unconditionally."""
+        if self._tenants is None:
+            return []
+        recs = []
+        with self._counts_lock:
+            frozen = [(name, dict(st.counts), st.queued,
+                       st.hist.n, st.hist.total, st.hist.max,
+                       st.hist.quantile(0.5), st.hist.quantile(0.99))
+                      for name, st in sorted(self._tenant_states.items())]
+        for (name, c, queued, n, total, mx, p50, p99) in frozen:
+            st = self._tenant_states[name]
+            cls = st.cls
+            rec = {
+                "tenant": name,
+                "priority": cls.priority,
+                "admission_weight": cls.admission_weight,
+                "shed_grace": cls.shed_grace,
+                "queued": queued,
+                "shed": (c["rejected"] + c["displaced"]
+                         + c["deadline_expired"]),
+                **c,
+                "latency": {
+                    "n": n,
+                    "mean_ms": 1e3 * total / n if n else None,
+                    "p50_ms": 1e3 * p50 if n else None,
+                    "p99_ms": 1e3 * p99 if n else None,
+                    "max_ms": 1e3 * mx if n else None,
+                },
+            }
+            if st.budget is not None:
+                rec["slo"] = st.budget.snapshot()
+            recs.append(rec)
+        return recs
+
+    def emit_tenants(self, sink) -> list:
+        """Append one per-tenant record per registered class to a
+        ``metrics.MetricsSink`` as kind ``tenant`` — the per-tenant
+        leg of the observability plane (TelemetryHub ingests these
+        into ``tenant_*`` series; the fleet aggregator exports them as
+        ``qt_tenant_*{tenant=...}``)."""
+        recs = self.tenant_snapshots()
+        for rec in recs:
+            sink.emit(rec, kind="tenant")
+        return recs
+
     def report(self) -> str:
         """Human-readable one-stop summary."""
         s = self.snapshot()
@@ -1423,4 +1834,12 @@ class MicroBatchServer:
                 f"budget remaining "
                 f"{'n/a' if rem is None else f'{100.0 * rem:.1f}%'}"
                 f"{', SHEDDING' if sl['shedding'] else ''}")
+        for t in self.tenant_snapshots():
+            p99 = t["latency"]["p99_ms"]
+            lines.append(
+                f"tenant {t['tenant']}: {t['requests']} requests, "
+                f"{t['completed']} completed, {t['shed']} shed "
+                f"({t['rejected']} rejected, {t['displaced']} "
+                f"displaced, {t['deadline_expired']} expired), p99 "
+                f"{'n/a' if p99 is None else f'{p99:.1f} ms'}")
         return "\n".join(lines)

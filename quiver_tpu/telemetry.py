@@ -658,9 +658,10 @@ class TelemetryHub:
     #: the sink-file record kinds :meth:`ingest_jsonl` folds by
     #: default: counter-bearing ``step_stats``, plus the serve-side
     #: health a fleet merge needs — ``serving`` (a step_stats payload
-    #: with request percentiles / queue depth / shed level) and ``slo``
-    #: (burn rates)
-    INGEST_KINDS = ("step_stats", "serving", "slo")
+    #: with request percentiles / queue depth / shed level), ``slo``
+    #: (burn rates), and ``tenant`` (per-tenant-class burn/p99/shed —
+    #: the multi-tenant accounting plane)
+    INGEST_KINDS = ("step_stats", "serving", "slo", "tenant")
 
     def ingest_records(self, recs, source: str,
                        kinds=INGEST_KINDS) -> int:
@@ -693,6 +694,11 @@ class TelemetryHub:
             kind = rec.get("kind")
             if kind == "slo":
                 self.ingest_slo(rec)
+                continue
+            if kind == "tenant":
+                # per-tenant series only — a tenant record carries no
+                # cumulative counters block to diff
+                self.ingest_tenant(rec)
                 continue
             # cumulative-diff state is per (source, kind): a sink that
             # interleaves step_stats and serving records carries TWO
@@ -741,6 +747,27 @@ class TelemetryHub:
         self.observe("serve_batch_fill", sv.get("mean_batch_fill"))
         if "slo" in snap:
             self.ingest_slo(snap["slo"])
+
+    def ingest_tenant(self, rec: dict) -> None:
+        """Series points from one ``serving`` per-tenant record (a
+        ``MicroBatchServer.tenant_snapshots()`` entry / kind ``tenant``
+        JSONL line): per-class p99, cumulative shed total, and — when
+        the class declares an SLO — the short-window burn rate. Series
+        names carry the tenant as a ``:<name>`` suffix, the same
+        per-key discipline the fleet aggregator's Prometheus export
+        re-labels into ``{tenant=...}``."""
+        name = rec.get("tenant")
+        if not name:
+            return
+        lat = rec.get("latency")
+        if isinstance(lat, dict):
+            self.observe(f"tenant_p99_ms:{name}", lat.get("p99_ms"))
+        self.observe(f"tenant_shed:{name}", rec.get("shed"))
+        slo = rec.get("slo")
+        if isinstance(slo, dict):
+            w = slo.get("windows", {})
+            self.observe(f"tenant_burn:{name}",
+                         w.get("short", {}).get("burn_rate"))
 
     def ingest_prefetch(self, stats: dict) -> None:
         """Series points from a ``ColdPrefetcher.stats()``-shaped dict

@@ -126,6 +126,18 @@ bit-compared against an UNSHARDED single-store engine replaying the
 identical seed sequence (same PRNG chain): partitioning changes where
 rows live, never what the model computes.
 
+Phase 16 pins TENANCY (qt-capacity): a replayed multi-tenant
+flash-crowd trace (``traffic.generate_scenario`` + ``traffic.replay``,
+10x best-effort surge) burst through a tenant-registry server with a
+tiny admission queue, forcing a shed episode — admission rejects,
+displacement, class-pure coalescing, per-class quality shed. Tenancy
+is host-side accounting + queue discipline by construction; this phase
+makes it measured: zero executable growth, zero recompiles through the
+server's watch, flat live arrays, and the per-tenant counters EXACT
+against both the replay driver's own per-tenant records and a
+hand-fold of the trace (every arrival accounted, nothing double- or
+un-counted across the reject/displace/complete paths).
+
 Run: JAX_PLATFORMS=cpu python scripts/check_leak.py
 """
 
@@ -1369,6 +1381,101 @@ def main():
         "device buffer leak across fused multi-hop train+serve steps"
     print("no leak detected (phase 15: 50 fused multi-hop train+serve "
           "steps, losses and rows bit-identical to the split replay)")
+
+    # ---- phase 16: replayed multi-tenant load across a shed episode ----
+    # qt-capacity's leak contract: tenancy (class registry, weighted
+    # admission shares, displacement, class-pure shed batching) is
+    # host-side accounting + queue discipline ONLY. A flash-crowd
+    # trace replayed at a burst speed that swamps a tiny admission
+    # queue must shed — and still grow zero executables, zero
+    # recompiles, flat arrays, with per-tenant counters EXACT against
+    # the replay driver's records and a hand-fold of the trace.
+    from quiver_tpu import traffic
+    from quiver_tpu.serving import default_tenant_classes
+
+    tserver = MicroBatchServer(
+        engine,                       # phase 6's warmed 3-variant engine
+        ServeConfig(max_wait_ms=2.0, queue_depth=16,
+                    shed_queue_frac=0.25, calm_batches=2,
+                    slo_p99_ms=50.0),
+        tenants=default_tenant_classes(slo_p99_ms=50.0))
+    # settle: one calm wave through every class (compiles nothing new;
+    # the registry reuses phase 6's programs untouched)
+    for f in [tserver.submit(int(i), tenant=t)
+              for i, t in zip(rng.integers(0, n, 9),
+                              ["interactive", "batch", "best_effort"] * 3)]:
+        f.result(timeout=60)
+    gc.collect()
+    base_arrays = len(jax.live_arrays())
+    base_cache = sum(f._cache_size() for f in engine.jitted_fns)
+    settle = {t["tenant"]: dict(t) for t in tserver.tenant_snapshots()}
+
+    trace = traffic.generate_scenario(
+        "flash_crowd", 40.0, 25.0, n, seed=17,
+        flash_tenant="best_effort", flash_x=10.0)
+    # speed 500 compresses the 40 s trace into ~80 ms of offered wall:
+    # ~1000 arrivals against a depth-16 queue GUARANTEES the shed
+    # episode (rejects + displacement), timing-independently
+    rep = traffic.replay(trace, tserver, speed=500.0)
+    snap = tserver.snapshot()
+    tenants_now = {t["tenant"]: t for t in tserver.tenant_snapshots()}
+    # close() first: the pipeline's in-flight batch slots hold the
+    # last dispatches' device buffers until the executor drains
+    tserver.close()
+    gc.collect()
+    arrays = len(jax.live_arrays())
+    grew = sum(f._cache_size() for f in engine.jitted_fns) - base_cache
+
+    # hand-fold the trace: per-tenant offered counts are a pure
+    # function of the generated arrays
+    fold = {name: 0 for name in trace["tenants"]}
+    for i in np.asarray(trace["tenant"]).tolist():
+        fold[trace["tenants"][i]] += 1
+    shed_total = 0
+    for name in trace["tenants"]:
+        r = rep["tenants"][name]
+        base_c = settle[name]
+        t = tenants_now[name]
+        assert r["offered"] == fold[name], \
+            f"replay offered[{name}] drifted from the trace hand-fold"
+        # every arrival accounted exactly once in the replay record
+        assert (r["completed"] + r["rejected"] + r["deadline_expired"]
+                + r["failed"]) == r["offered"], \
+            f"replay records leak arrivals for {name}"
+        # server counters (minus the settle wave) == replay counters:
+        # submit-raise rejects + displaced futures both classify as
+        # rejected on the driver side
+        assert (t["completed"] - base_c["completed"]) == \
+            r["completed"], f"completed drift for {name}"
+        assert (t["rejected"] + t["displaced"] - base_c["rejected"]
+                - base_c["displaced"]) == r["rejected"], \
+            f"reject/displace drift for {name}"
+        assert (t["deadline_expired"] - base_c["deadline_expired"]) \
+            == r["deadline_expired"], f"deadline drift for {name}"
+        assert (t["failed"] - base_c["failed"]) == r["failed"], \
+            f"failure drift for {name}"
+        shed_total += r["rejected"]
+    be_shed = rep["tenants"]["best_effort"]["rejected"]
+    ia_shed = rep["tenants"]["interactive"]["rejected"]
+    mix = snap["serving"]["variant_batches"]
+    print(f"phase 16 live arrays: {base_arrays} -> {arrays}; "
+          f"tenant-replay executable-cache growth: {grew}; "
+          f"recompiles: {snap['recompiles']}; shed {shed_total} "
+          f"(best_effort {be_shed}, interactive {ia_shed}); "
+          f"variant mix: {mix}")
+    assert shed_total > 0, \
+        "the burst never shed (phase premise: the queue must overflow)"
+    assert be_shed >= ia_shed, \
+        "shed order inverted: best_effort must absorb before interactive"
+    assert grew == 0, \
+        "tenancy recompiled mid-replay (it must reuse the warmed " \
+        "programs untouched)"
+    assert snap["recompiles"] == 0, \
+        "server's recompile watch fired under tenant-registry traffic"
+    assert arrays <= base_arrays + 16, \
+        "device buffer leak across the replayed multi-tenant episode"
+    print("no leak detected (phase 16: replayed multi-tenant flash "
+          "crowd across a shed episode, per-tenant counters exact)")
 
 
 if __name__ == "__main__":

@@ -94,7 +94,9 @@ for path, varnames in (
         ("quiver_tpu/telemetry.py", ("DETECTOR_NAMES", "ADVICE_KEYS")),
         ("quiver_tpu/profile.py", ("PROFILE_SERIES",)),
         ("quiver_tpu/tailsampling.py", ("TAIL_POLICY_NAMES",)),
-        ("quiver_tpu/actuator.py", ("ACTUATION_KEYS",))):
+        ("quiver_tpu/actuator.py", ("ACTUATION_KEYS",)),
+        ("quiver_tpu/serving.py", ("TENANT_CLASS_NAMES",)),
+        ("quiver_tpu/traffic.py", ("SCENARIO_NAMES",))):
     for group, names in const_tuples(path, varnames).items():
         if not names:
             print(f"DRIFT: could not read {group} from {path}")

@@ -1,0 +1,154 @@
+"""qt_capacity — the fleet capacity report.
+
+Renders the latest ``capacity`` JSONL record (the prediction +
+replay-verdict block ``benchmarks/bench_capacity.py`` emits), and with
+``--predict`` derives a FRESH prediction from the newest observed
+``serving`` records in the same history (dispatch p50, mean batch
+fill, knob readbacks — the ``capacity.observe_serving`` fold), the
+analytic knobs given on the command line, and (unless ``--no-probe``)
+this box's roofline probe — emitting it back into the history as a new
+``capacity`` record.
+
+The model is ``quiver_tpu.capacity`` (host-side arithmetic; see its
+docstring for the ρ* heuristic and the honesty contract: predictions
+are gated against replayed measurement by ``bench_capacity.py``, not
+trusted). Reading + predicting never claims an accelerator unless the
+probe runs.
+
+Usage: python scripts/qt_capacity.py [--jsonl PATH] [--predict]
+           [--replicas N] [--budget-ms F] [--batch-cap N]
+           [--dispatch-ms F] [--max-wait-ms F] [--fill F]
+           [--mix interactive=5,batch=3,best_effort=2]
+           [--no-probe] [--no-color]
+
+Exit status 0 unless the report itself fails; when the latest record
+carries a verdict, a ``within_tol = False`` verdict renders red but
+the gate belongs to ``bench_capacity.py``.
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from quiver_tpu import capacity as qcap          # noqa: E402
+from quiver_tpu import metrics as qm             # noqa: E402
+
+
+def _c(code: str, s: str, color: bool) -> str:
+    return f"\x1b[{code}m{s}\x1b[0m" if color else s
+
+
+def _parse_mix(text):
+    if not text:
+        return None
+    mix = {}
+    for part in text.split(","):
+        name, _, w = part.partition("=")
+        mix[name.strip()] = float(w) if w else 1.0
+    return mix
+
+
+def render(rec: dict, color: bool) -> str:
+    lines = []
+    per = rec.get("per_tenant_rps") or {}
+    rate = _c("1", f"{rec.get('predicted_rps', 0.0):.0f} req/s", color)
+    lines.append(
+        f"capacity: {rec.get('replicas', '?')} replica(s) sustain "
+        f"{rate} "
+        f"within p99 {rec.get('budget_p99_ms', 0.0):.1f} ms "
+        f"(cycle {rec.get('cycle_ms', rec.get('service_ms', 0.0)):.2f} ms,"
+        f" fill "
+        f"{rec.get('fill', 0.0):.1f}/{rec.get('batch_cap', '?')}, "
+        f"utilization cap {rec.get('utilization_cap', 0.0):.2f})")
+    if rec.get("floor_ms") is not None:
+        lines.append(f"  roofline floor: {rec['floor_ms']:.3f} ms "
+                     f"(dispatch measured {rec.get('dispatch_ms', 0.0):.3f} ms)")
+    for t, rps in sorted(per.items()):
+        share = (rec.get("mix") or {}).get(t)
+        lines.append(f"  tenant {t}: {rps:.0f} req/s"
+                     + (f" ({100.0 * share:.0f}% of mix)"
+                        if share is not None else ""))
+    v = rec.get("verdict")
+    if isinstance(v, dict):
+        ok = bool(v.get("within_tol"))
+        tag = _c("32", "WITHIN TOL", color) if ok else \
+            _c("31", "OUT OF TOL", color)
+        lines.append(
+            f"  replay verdict: predicted {v.get('predicted_rps', 0.0):.0f}"
+            f" vs measured {v.get('measured_rps', 0.0):.0f} req/s — "
+            f"ratio {v.get('ratio', 0.0):.2f} "
+            f"(±{100.0 * v.get('tol', 0.0):.0f}% gate) {tag}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--jsonl",
+                    default=os.environ.get("QT_METRICS_JSONL",
+                                           "benchmarks/metrics.jsonl"))
+    ap.add_argument("--predict", action="store_true",
+                    help="derive a fresh prediction from observed "
+                         "serving records + these knobs")
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--budget-ms", type=float, default=50.0)
+    ap.add_argument("--batch-cap", type=int, default=None)
+    ap.add_argument("--dispatch-ms", type=float, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--overhead-ms", type=float, default=0.0,
+                    help="per-request host overhead (the coalescer "
+                         "side of the pipeline; bench_capacity "
+                         "calibrates it from a serial round-trip)")
+    ap.add_argument("--fill", type=float, default=None)
+    ap.add_argument("--mix", type=str, default=None,
+                    help="tenant=weight[,tenant=weight...]")
+    ap.add_argument("--no-probe", action="store_true")
+    ap.add_argument("--no-color", action="store_true")
+    a = ap.parse_args(argv)
+    color = not a.no_color and sys.stdout.isatty()
+
+    recs = qm.read_jsonl(a.jsonl) if os.path.exists(a.jsonl) else []
+    caps = [r for r in recs if r.get("kind") == "capacity"]
+    if caps and not a.predict:
+        print(render(caps[-1], color))
+        return 0
+
+    if not a.predict:
+        print(f"no capacity records in {a.jsonl} "
+              f"(run benchmarks/bench_capacity.py, or pass --predict)")
+        return 0
+
+    observed = qcap.observe_serving(
+        [r for r in recs if r.get("kind") == "serving"])
+    batch_cap = a.batch_cap or observed.get("batch_cap")
+    dispatch_ms = a.dispatch_ms or observed.get("dispatch_ms")
+    if batch_cap is None or dispatch_ms is None:
+        print("need --batch-cap and --dispatch-ms (no observed "
+              f"serving records in {a.jsonl} to derive them from)")
+        return 1
+    max_wait_ms = (a.max_wait_ms if a.max_wait_ms is not None
+                   else observed.get("max_wait_ms", 2.0))
+    probe = None
+    if not a.no_probe:
+        from quiver_tpu.profile import machine_probe
+        probe = machine_probe(quick=True)
+    rec = qcap.predict(batch_cap=int(batch_cap),
+                       dispatch_ms=float(dispatch_ms),
+                       budget_p99_ms=a.budget_ms,
+                       replicas=a.replicas,
+                       max_wait_ms=float(max_wait_ms),
+                       overhead_per_req_ms=a.overhead_ms,
+                       fill=a.fill, mix=_parse_mix(a.mix),
+                       probe=probe)
+    rec["source"] = "qt_capacity --predict"
+    print(render(rec, color))
+    sink = qm.MetricsSink(a.jsonl)
+    qcap.emit(sink, rec)
+    print(f"capacity record appended -> {a.jsonl}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

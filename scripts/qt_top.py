@@ -18,6 +18,14 @@ render, in place, one compact frame per refresh:
   sentinel, and ``lint`` findings from ``scripts/qt_verify.py``
   (ERROR red, WARN yellow — the static invariant verifier's
   verdicts);
+- the TENANT panel when the sink carries ``tenant`` records (the
+  per-class leg of qt-capacity): one row per tenant class, latest
+  record wins — SLO burn-rate sparkline, completed/shed/reject
+  counts, p99 — shed classes flagged by color;
+- the capacity line from the newest ``capacity`` record (the
+  prediction ``benchmarks/bench_capacity.py`` / ``qt_capacity
+  --predict`` emits), with its replay verdict colored by
+  ``within_tol``;
 - the FLEET panel when the sink carries ``fleet`` records (point it at
   ``scripts/qt_agg.py``'s ``--jsonl``): one row per replica — health
   score colored by threshold, STALE flagged red — plus the fleet
@@ -88,8 +96,10 @@ def build_series(records):
     anomalies, advice, regress, lint, prof = [], {}, {}, {}, {}
     act = {}
     traces = {}
+    tenants = {}
     slo = None
     fleet = None
+    capacity = None
 
     def put(name, v):
         if _num(v):
@@ -142,6 +152,23 @@ def build_series(records):
             fleet = rec
             for name, r in (rec.get("replicas") or {}).items():
                 put(f"health:{name}", r.get("health"))
+        elif kind == "tenant":
+            # latest per tenant class (the lint/advice dedup
+            # discipline: a server re-emits every class per snapshot
+            # and only the newest counters matter) — but every record
+            # contributes burn-rate POINTS so the panel shows trend
+            name = rec.get("tenant", "?")
+            tenants[name] = rec
+            w = (rec.get("slo") or {}).get("windows") or {}
+            put(f"tenant_burn:{name}",
+                (w.get("short") or {}).get("burn_rate"))
+        elif kind == "replay":
+            # per-tenant measured p99 from the trace-replay driver —
+            # the proving-ground trend next to the tenant panel
+            put(f"replay_p99:{rec.get('tenant', '?')}",
+                (rec.get("latency") or {}).get("p99_ms"))
+        elif kind == "capacity":
+            capacity = rec                        # newest verdict wins
         elif kind == "anomaly":
             anomalies.append(rec)
         elif kind == "advice":
@@ -170,7 +197,7 @@ def build_series(records):
             if rec.get("trace_id") is not None:
                 traces[rec["trace_id"]] = rec
     return (series, anomalies, advice, act, regress, lint, prof,
-            traces, slo, fleet)
+            traces, tenants, capacity, slo, fleet)
 
 
 def sparkline(values, width):
@@ -235,8 +262,8 @@ def render(path, limit, width, color=True, fleet_only=False):
     c = (lambda code, s: f"{code}{s}{RESET}") if color else \
         (lambda code, s: s)
     records = read_records(path, limit)
-    (series, anomalies, advice, act, regress, lint, prof, traces, slo,
-     fleet) = build_series(records)
+    (series, anomalies, advice, act, regress, lint, prof, traces,
+     tenants, capacity, slo, fleet) = build_series(records)
     lines = [c(BOLD, f"qt_top — {path}  "
                      f"({len(records)} records, "
                      f"{time.strftime('%H:%M:%S')})")]
@@ -278,6 +305,48 @@ def render(path, limit, width, color=True, fleet_only=False):
         if shedding:
             txt += "  SHEDDING"
         lines.append(c(RED if shedding else GREEN, txt))
+    # tenant panel: one row per class, newest record wins (ordered by
+    # priority, highest first — the shed order reversed); burn trend
+    # as a sparkline, shed counts colored by whether the class is
+    # absorbing load shed right now
+    name_t = max((len(n) for n in tenants), default=0)
+    for name in sorted(tenants,
+                       key=lambda n: (-tenants[n].get("priority", 0),
+                                      n)):
+        t = tenants[name]
+        lat = t.get("latency") or {}
+        p99 = lat.get("p99_ms")
+        shed = t.get("shed", 0)
+        sl = t.get("slo") or {}
+        burn = ((sl.get("windows") or {}).get("short")
+                or {}).get("burn_rate")
+        trend = series.get(f"tenant_burn:{name}", [])
+        spark = sparkline(trend, width) if trend else ""
+        tint = (RED if _num(burn) and burn > 1.0
+                else YELLOW if shed else GREEN)
+        lines.append(c(tint, (
+            f"  tenant {name:<{name_t}} p{t.get('priority', '?')}  "
+            f"{spark:<{width}}  "
+            f"done {t.get('completed', 0)}  shed {shed} "
+            f"(rej {t.get('rejected', 0)} disp "
+            f"{t.get('displaced', 0)} ddl "
+            f"{t.get('deadline_expired', 0)})  "
+            f"p99 {fmt(p99) if _num(p99) else 'n/a'} ms  "
+            f"burn {fmt(burn) if _num(burn) else 'n/a'}")))
+    if capacity is not None:
+        v = capacity.get("verdict") or {}
+        ok = v.get("within_tol")
+        txt = (f"capacity: {capacity.get('replicas', '?')} replica(s) "
+               f"sustain {fmt(capacity.get('predicted_rps', 0))} req/s "
+               f"within p99 "
+               f"{fmt(capacity.get('budget_p99_ms', 0))} ms "
+               f"(fill {capacity.get('fill', '?')}"
+               f"/{capacity.get('batch_cap', '?')})")
+        if v:
+            txt += (f"  replay {fmt(v.get('measured_rps', 0))} req/s, "
+                    f"ratio {v.get('ratio', '?')} "
+                    + ("WITHIN TOL" if ok else "OUT OF TOL"))
+        lines.append(c(GREEN if ok or not v else RED, txt))
     if fleet is not None:
         lines += render_fleet(fleet, series, width, c)
     lines += anomaly_lines()

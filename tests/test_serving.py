@@ -27,6 +27,15 @@ The contracts under test:
    nest consistently (parent/child); the SLO error-budget burn-rate
    trigger sheds quality (replacing the raw recent-p99 trigger) and
    the budget block rides the ``serving`` JSONL record.
+6. **Tenancy** — with a ``TenantClass`` registry, shed ORDER is
+   policy: a pressed queue rejects the class already holding its
+   weighted share ("holds its share"), a full queue displaces the
+   NEWEST lowest-priority request (never the reverse direction), and
+   quality shed consumes zero-grace classes first (``shed_grace``
+   ladder steps). Accounting is exact per class and lands as kind
+   ``tenant`` JSONL. Tenancy is host-side only: served logits are
+   bit-identical with the registry on or off, and a server without a
+   registry accepts-and-ignores the ``tenant`` argument.
 """
 
 import json
@@ -669,3 +678,238 @@ class TestShardedServe:
             assert rec["partition"] == {"home": 0, "partitions": 2}
         finally:
             srv.close()
+
+
+class _GateEngine:
+    """Jax-free gated engine for deterministic admission tests:
+    ``batch_cap=1`` makes every dispatch a single-request batch, and
+    ``run`` blocks on ``gate`` — so a test stages EXACT queue contents
+    while the first request sits mid-dispatch, then releases the gate
+    to drain. ``calls`` records every ``(seeds, variant)`` dispatch."""
+
+    collect_metrics = False
+    jitted_fns = ()
+
+    def __init__(self, n_variants=2):
+        self.batch_cap = 1
+        self.variants = [[4, 4]] + [[1, 1]] * (n_variants - 1)
+        self.gate = threading.Event()
+        self.gate.set()
+        self.started = threading.Event()
+        self.calls = []
+
+    def run(self, seeds, variant=0):
+        self.started.set()
+        assert self.gate.wait(timeout=10)
+        self.calls.append((np.asarray(seeds).copy(), int(variant)))
+        out = np.zeros((self.batch_cap, 2), np.float32)
+        out[:, 0] = np.asarray(seeds, np.float32)
+        return out
+
+
+class TestTenancy:
+    def test_unknown_tenant_rejected(self):
+        eng = _GateEngine()
+        srv = qv.MicroBatchServer(eng, qv.ServeConfig(max_wait_ms=1.0),
+                                  tenants=qv.default_tenant_classes())
+        try:
+            with pytest.raises(ValueError, match="unknown tenant"):
+                srv.submit(1, tenant="nobody")
+        finally:
+            srv.close()
+
+    def test_tenant_ignored_without_registry(self, engine, reference):
+        srv = qv.MicroBatchServer(engine,
+                                  qv.ServeConfig(max_wait_ms=1.0))
+        try:
+            row = srv.submit(3, tenant="whoever").result(timeout=10)
+        finally:
+            srv.close()
+        np.testing.assert_allclose(row, reference[3], rtol=1e-5,
+                                   atol=1e-6)
+        assert srv.tenant_snapshots() == []
+
+    def test_none_tenant_lands_in_lowest_priority_class(self):
+        eng = _GateEngine()
+        srv = qv.MicroBatchServer(eng, qv.ServeConfig(max_wait_ms=1.0),
+                                  tenants=qv.default_tenant_classes())
+        try:
+            assert srv.submit(5).result(timeout=10)[0] == 5.0
+            snaps = {t["tenant"]: t for t in srv.tenant_snapshots()}
+            assert snaps["best_effort"]["requests"] == 1
+            assert snaps["best_effort"]["completed"] == 1
+            assert snaps["interactive"]["requests"] == 0
+            assert snaps["batch"]["requests"] == 0
+        finally:
+            srv.close()
+
+    def test_share_cap_rejects_flooding_class_only(self):
+        # queue_depth=7, weights 4:2:1 -> shares ceil(4)=4 / 2 / 1;
+        # shed_at = int(7 * 0.3) = 2. The first best_effort submit is
+        # popped into the gated dispatch, two more fill the queue past
+        # the threshold with best_effort over its share of 1 — the
+        # fourth is shed at the door while interactive still admits.
+        eng = _GateEngine()
+        eng.gate.clear()
+        srv = qv.MicroBatchServer(
+            eng, qv.ServeConfig(max_wait_ms=0.5, queue_depth=7,
+                                shed_queue_frac=0.3, calm_batches=100),
+            tenants=qv.default_tenant_classes())
+        try:
+            futs = [srv.submit(0, tenant="best_effort")]
+            assert eng.started.wait(timeout=10)
+            futs += [srv.submit(i, tenant="best_effort")
+                     for i in (1, 2)]
+            with pytest.raises(qv.OverloadError, match="holds its share"):
+                srv.submit(3, tenant="best_effort")
+            futs.append(srv.submit(4, tenant="interactive"))
+            eng.gate.set()
+            assert [f.result(timeout=10)[0] for f in futs] == \
+                [0.0, 1.0, 2.0, 4.0]
+            snaps = {t["tenant"]: t for t in srv.tenant_snapshots()}
+            be = snaps["best_effort"]
+            assert be["rejected"] == 1 and be["shed"] == 1
+            assert be["requests"] == 3 and be["completed"] == 3
+            ia = snaps["interactive"]
+            assert ia["rejected"] == 0 and ia["completed"] == 1
+        finally:
+            eng.gate.set()
+            srv.close()
+
+    def test_displacement_evicts_newest_lowest_priority(self):
+        # queue_depth=2, shed_queue_frac=1.0 (share cap never fires:
+        # shed_at=2 is only reached when the queue is already full).
+        # With the dispatch gated and the queue full of best_effort, an
+        # interactive submit displaces the NEWEST best_effort request —
+        # its future fails typed, the interactive one takes the slot.
+        eng = _GateEngine()
+        eng.gate.clear()
+        srv = qv.MicroBatchServer(
+            eng, qv.ServeConfig(max_wait_ms=0.5, queue_depth=2,
+                                shed_queue_frac=1.0, calm_batches=100),
+            tenants=qv.default_tenant_classes())
+        try:
+            f0 = srv.submit(0, tenant="best_effort")
+            assert eng.started.wait(timeout=10)
+            f1 = srv.submit(1, tenant="best_effort")
+            f2 = srv.submit(2, tenant="best_effort")   # newest queued
+            f3 = srv.submit(3, tenant="interactive")
+            with pytest.raises(qv.OverloadError, match="displaced"):
+                f2.result(timeout=5)
+            eng.gate.set()
+            assert f0.result(timeout=10)[0] == 0.0
+            assert f1.result(timeout=10)[0] == 1.0
+            assert f3.result(timeout=10)[0] == 3.0
+            snaps = {t["tenant"]: t for t in srv.tenant_snapshots()}
+            be = snaps["best_effort"]
+            assert be["displaced"] == 1 and be["shed"] == 1
+            assert be["completed"] == 2
+            assert snaps["interactive"]["completed"] == 1
+            # a best_effort submit into the full queue must NOT
+            # displace its own class (no strictly-lower priority left)
+            eng.gate.clear()
+            eng.started.clear()
+            g0 = srv.submit(0, tenant="best_effort")
+            assert eng.started.wait(timeout=10)
+            g1 = srv.submit(1, tenant="interactive")
+            g2 = srv.submit(2, tenant="interactive")
+            with pytest.raises(qv.OverloadError, match="queue full"):
+                srv.submit(3, tenant="best_effort")
+            eng.gate.set()
+            for g in (g0, g1, g2):
+                assert g.result(timeout=10) is not None
+        finally:
+            eng.gate.set()
+            srv.close()
+
+    def test_shed_grace_orders_quality_shed(self):
+        # With the local shed level raised one step, a zero-grace
+        # class's batches take the degraded variant while a graced
+        # class still dispatches full quality — shed ORDER is policy.
+        # calm_batches is huge so the level holds for the whole test.
+        eng = _GateEngine(n_variants=2)
+        srv = qv.MicroBatchServer(
+            eng, qv.ServeConfig(max_wait_ms=0.5, queue_depth=64,
+                                shed_queue_frac=1.0, calm_batches=10_000),
+            tenants=qv.default_tenant_classes())
+        try:
+            srv._shed_level = 1
+            assert srv.submit(7, tenant="interactive") \
+                      .result(timeout=10)[0] == 7.0
+            assert srv.submit(8, tenant="best_effort") \
+                      .result(timeout=10)[0] == 8.0
+            assert srv.submit(9, tenant="batch") \
+                      .result(timeout=10)[0] == 9.0
+            variants = [v for _, v in eng.calls]
+            # interactive: grace 8 swallows the step -> variant 0;
+            # best_effort: grace 0 -> variant 1; batch: grace 1 -> 0
+            assert variants == [0, 1, 0]
+        finally:
+            srv.close()
+
+    def test_tenant_snapshots_and_jsonl(self, engine, tmp_path):
+        srv = qv.MicroBatchServer(
+            engine, qv.ServeConfig(max_wait_ms=2.0, queue_depth=64,
+                                   shed_queue_frac=1.0),
+            tenants=qv.default_tenant_classes(slo_p99_ms=200.0))
+        try:
+            futs = [srv.submit(i, tenant=t)
+                    for t, k in (("interactive", 3), ("batch", 2),
+                                 ("best_effort", 1))
+                    for i in range(k)]
+            for f in futs:
+                assert f.result(timeout=10) is not None
+            path = tmp_path / "tenants.jsonl"
+            with qm.MetricsSink(str(path)) as sink:
+                recs = srv.emit_tenants(sink)
+        finally:
+            srv.close()
+        by = {r["tenant"]: r for r in recs}
+        assert sorted(by) == ["batch", "best_effort", "interactive"]
+        for name, n in (("interactive", 3), ("batch", 2),
+                        ("best_effort", 1)):
+            r = by[name]
+            assert r["requests"] == n and r["completed"] == n
+            assert r["shed"] == 0 and r["queued"] == 0
+            assert r["latency"]["n"] == n
+            assert r["latency"]["p99_ms"] > 0
+        # SLO budget blocks ride only the classes that declare targets
+        assert by["interactive"]["slo"]["target_p99_ms"] == 200.0
+        assert by["batch"]["slo"]["target_p99_ms"] == 800.0
+        assert "slo" not in by["best_effort"]
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [l["kind"] for l in lines] == \
+            ["meta", "tenant", "tenant", "tenant"]
+        assert sorted(l["tenant"] for l in lines[1:]) == \
+            ["batch", "best_effort", "interactive"]
+
+    def test_logits_bit_identical_with_tenancy(self, world):
+        # tenancy is host-side accounting + queue discipline ONLY: the
+        # seed block and the dispatched program are unchanged, so with
+        # the key chain rewound to the same state, calm traffic yields
+        # BYTE-identical rows with the registry on vs off — for every
+        # class and for the tenant-less default path alike. One
+        # engine, one compile (the chain rewind replays the exact same
+        # program inputs, as in test_traced_logits_bit_identical).
+        model, params, ij, xj, feat = world
+        eng = qv.ServeEngine(model, params, (ij, xj), feat,
+                             sizes_variants=[FULL, SHED],
+                             batch_cap=CAP, seed=13)
+        plan = ((3, "interactive"), (9, "batch"), (14, "best_effort"),
+                (21, None))
+        rows = {}
+        for tenants in (None, qv.default_tenant_classes()):
+            eng._key = jax.random.key(13)    # rewind the donated chain
+            srv = qv.MicroBatchServer(
+                eng, qv.ServeConfig(max_wait_ms=1.0, queue_depth=64,
+                                    shed_queue_frac=1.0),
+                tenants=tenants)
+            try:
+                for nid, tenant in plan:
+                    row = srv.submit(nid, tenant=tenant) \
+                             .result(timeout=10)
+                    rows.setdefault(nid, []).append(row)
+            finally:
+                srv.close()
+        for nid, (off, on) in rows.items():
+            assert off.tobytes() == on.tobytes(), nid

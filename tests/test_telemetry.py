@@ -682,6 +682,49 @@ class TestQtTop:
         assert out.returncode == 0
         assert "no records yet" in out.stdout
 
+    def test_tenant_panel_and_capacity_line(self, tmp_path):
+        # the qt-capacity panels: latest tenant record wins per class
+        # (rows ordered by priority, highest first), replay p99 series
+        # appears, and the newest capacity record renders its verdict
+        p = tmp_path / "m.jsonl"
+        recs = [
+            {"kind": "tenant", "tenant": "interactive", "priority": 2,
+             "completed": 10, "shed": 0, "rejected": 0, "displaced": 0,
+             "deadline_expired": 0, "latency": {"p99_ms": 12.0},
+             "slo": {"windows": {"short": {"burn_rate": 0.4}}}},
+            {"kind": "tenant", "tenant": "interactive", "priority": 2,
+             "completed": 25, "shed": 0, "rejected": 0, "displaced": 0,
+             "deadline_expired": 0, "latency": {"p99_ms": 11.0},
+             "slo": {"windows": {"short": {"burn_rate": 0.6}}}},
+            {"kind": "tenant", "tenant": "best_effort", "priority": 0,
+             "completed": 5, "shed": 3, "rejected": 2, "displaced": 1,
+             "deadline_expired": 0, "latency": {"p99_ms": 80.0}},
+            {"kind": "replay", "tenant": "interactive",
+             "latency": {"p99_ms": 14.0}},
+            {"kind": "capacity", "replicas": 1,
+             "predicted_rps": 2100.0, "budget_p99_ms": 100.0,
+             "fill": 12.4, "batch_cap": 16,
+             "verdict": {"within_tol": True, "measured_rps": 1980.0,
+                         "ratio": 1.06}},
+        ]
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        out = self._run("--jsonl", str(p))
+        assert out.returncode == 0, out.stderr
+        # latest-per-tenant dedup: the newest interactive counters
+        assert "done 25" in out.stdout and "done 10" not in out.stdout
+        assert "shed 3 (rej 2 disp 1 ddl 0)" in out.stdout
+        # burn sparkline series saw BOTH records (trend, not latest)
+        assert "tenant_burn:interactive" in out.stdout
+        assert "replay_p99:interactive" in out.stdout
+        # priority order: interactive's row above best_effort's
+        lines = out.stdout.splitlines()
+        rows = [i for i, l in enumerate(lines)
+                if l.lstrip().startswith("tenant ")]
+        assert "interactive" in lines[rows[0]]
+        assert "best_effort" in lines[rows[1]]
+        assert "capacity: 1 replica(s) sustain 2100 req/s" in out.stdout
+        assert "WITHIN TOL" in out.stdout
+
 
 class TestBenchRegressEmission:
     SCRIPT = os.path.join(REPO, "scripts", "bench_regress.py")
@@ -707,6 +750,57 @@ class TestBenchRegressEmission:
         assert v["regressed"] is True
         assert v["value"] == 80.0 and v["best"] == 100.0
         assert v["ratio"] == pytest.approx(0.8)
+
+    def test_reanchor_escape_hatch(self, tmp_path):
+        # the box-drift escape hatch: a 20% drop fails the gate, but
+        # --reanchor restarts that ONE metric's trajectory — visible
+        # (REANCHOR line, `reanchored` + box fingerprint in the
+        # verdict record), never silent, other metrics still judged
+        self._bench_file(tmp_path, 1, 100.0)
+        self._bench_file(tmp_path, 2, 80.0)
+        out_path = tmp_path / "verdicts.jsonl"
+        p = subprocess.run(
+            [sys.executable, self.SCRIPT, "--bench-dir", str(tmp_path),
+             "--reanchor", "seps", "--emit-jsonl", str(out_path)],
+            capture_output=True, text=True, timeout=60)
+        assert p.returncode == 0, p.stdout
+        assert "REANCHOR seps" in p.stdout
+        recs = [json.loads(l) for l in out_path.read_text().splitlines()]
+        v = {(r["metric"], r["platform"]): r for r in recs}[
+            ("seps", "default")]
+        assert v["reanchored"] is True and not v["regressed"]
+        assert v["box"]                      # the fingerprint note
+        assert v["best"] == 100.0            # prior kept for the record
+
+    def test_committed_round_reanchor_field(self, tmp_path):
+        # the durable reanchor: a round record carrying
+        # "reanchor": [...] restarts those metrics' history at that
+        # round for EVERY later invocation — no flag needed — while
+        # metrics not named are still judged against the full history
+        self._bench_file(tmp_path, 1, 100.0)
+        rec = {"metric": "seps", "value": 80.0, "unit": "edges/s"}
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "cmd": "x", "rc": 0, "reanchor": ["seps"],
+             "tail": json.dumps(rec)}))
+        out_path = tmp_path / "verdicts.jsonl"
+        p = subprocess.run(
+            [sys.executable, self.SCRIPT, "--bench-dir", str(tmp_path),
+             "--emit-jsonl", str(out_path)],
+            capture_output=True, text=True, timeout=60)
+        assert p.returncode == 0, p.stdout
+        recs = [json.loads(l) for l in out_path.read_text().splitlines()]
+        v = {(r["metric"], r["platform"]): r for r in recs}[
+            ("seps", "default")]
+        assert not v["regressed"]
+        assert v["best"] is None             # pre-restart history gone
+        assert v["value"] == 80.0
+        # a LATER drop against the restarted anchor still fails
+        self._bench_file(tmp_path, 3, 60.0)  # 25% below the new anchor
+        p = subprocess.run(
+            [sys.executable, self.SCRIPT, "--bench-dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert p.returncode == 1
+        assert "80" in p.stdout              # judged vs the new anchor
 
     def test_clean_trajectory_emits_pass_verdict(self, tmp_path):
         self._bench_file(tmp_path, 1, 100.0)

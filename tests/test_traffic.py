@@ -1,0 +1,259 @@
+"""Trace-replay load generation: determinism, scenario shape, replay
+accounting (qt-capacity's proving ground).
+
+The contracts under test:
+
+1. **Determinism** — ``generate_scenario`` is a pure function of
+   ``(name, seed, knobs)``: same seed → identical arrays, different
+   seed → different draws, for every scenario in ``SCENARIO_NAMES``.
+2. **Chunk invariance** — any ``[lo, hi)`` slicing assembles the
+   byte-identical trace (the ``datasets.generate_drifting_trace``
+   block contract, extended to arrival times via the closed-form
+   Λ-inversion): a sharded load generator produces the same flood as
+   a single process.
+3. **Scenario shape** — arrival times are sorted inside ``[0, T]``
+   and track the cumulative rate curve; the flash-crowd window
+   multiplies ONE tenant's arrival rate; the hot-key storm
+   concentrates in-window nodes into one contiguous region.
+4. **Replay accounting** — played against a deterministic stub
+   target, the per-tenant ``replay`` records reproduce the hand-fold
+   EXACTLY: offered per tenant matches the trace, rejects classify as
+   rejects (``rpc.Overloaded`` / ``serving.OverloadError``), deadline
+   expiries as expiries, generic errors as failures — and the records
+   land as kind ``replay`` JSONL.
+"""
+
+import concurrent.futures
+import json
+import os
+
+import numpy as np
+import pytest
+
+from quiver_tpu import metrics as qm
+from quiver_tpu import rpc as qrpc
+from quiver_tpu import traffic
+
+SCENARIO_KW = {
+    "steady": {},
+    "diurnal": {"diurnal_amp": 0.7},
+    "flash_crowd": {"flash_x": 8.0},
+    "hot_storm": {"storm_frac": 0.9},
+}
+
+
+class TestGenerateScenario:
+    @pytest.mark.parametrize("name", traffic.SCENARIO_NAMES)
+    def test_seeded_determinism(self, name):
+        kw = SCENARIO_KW[name]
+        a = traffic.generate_scenario(name, 20.0, 40.0, 500, seed=3, **kw)
+        b = traffic.generate_scenario(name, 20.0, 40.0, 500, seed=3, **kw)
+        c = traffic.generate_scenario(name, 20.0, 40.0, 500, seed=4, **kw)
+        for k in ("t", "tenant", "node"):
+            np.testing.assert_array_equal(a[k], b[k])
+        assert not np.array_equal(a["node"], c["node"])
+        assert a["tenants"] == tuple(sorted(traffic.DEFAULT_MIX))
+
+    @pytest.mark.parametrize("name", traffic.SCENARIO_NAMES)
+    def test_chunk_invariance(self, name):
+        kw = SCENARIO_KW[name]
+        whole = traffic.generate_scenario(name, 30.0, 30.0, 400,
+                                          seed=9, **kw)
+        n = whole["length"]
+        cuts = [0, n // 3, n // 3 + 1, 2 * n // 3, n]
+        for k in ("t", "tenant", "node"):
+            parts = [traffic.generate_scenario(
+                name, 30.0, 30.0, 400, seed=9, lo=lo, hi=hi, **kw)[k]
+                for lo, hi in zip(cuts, cuts[1:])]
+            np.testing.assert_array_equal(np.concatenate(parts),
+                                          whole[k])
+
+    @pytest.mark.parametrize("name", traffic.SCENARIO_NAMES)
+    def test_arrivals_sorted_in_window(self, name):
+        tr = traffic.generate_scenario(name, 25.0, 20.0, 300, seed=1,
+                                       **SCENARIO_KW[name])
+        t = tr["t"]
+        assert tr["length"] == len(t) > 0
+        assert (np.diff(t) >= 0).all()
+        assert t[0] >= 0.0 and t[-1] <= tr["duration_s"]
+        assert tr["node"].min() >= 0
+        assert tr["node"].max() < tr["nodes"]
+        assert tr["tenant"].min() >= 0
+        assert tr["tenant"].max() < len(tr["tenants"])
+
+    def test_flash_crowd_multiplies_one_tenant(self):
+        tr = traffic.generate_scenario(
+            "flash_crowd", 100.0, 50.0, 1000, seed=7,
+            flash_tenant="best_effort", flash_x=10.0,
+            flash_start_frac=0.4, flash_dur_frac=0.2)
+        be = tr["tenants"].index("best_effort")
+        t, tenant = tr["t"], tr["tenant"]
+        in_win = (t >= 40.0) & (t < 60.0)
+        rate_in = (tenant[in_win] == be).sum() / 20.0
+        out_mask = ~in_win
+        rate_out = (tenant[out_mask] == be).sum() \
+            / (100.0 - 20.0)
+        # 10x the weight inside the window -> the best_effort arrival
+        # rate itself is ~10x (both the total rate and the in-window
+        # mix account for the surge)
+        assert rate_in > 5.0 * rate_out
+        # the OTHER tenants keep their steady arrival rates
+        inter = tr["tenants"].index("interactive")
+        ri = (tenant[in_win] == inter).sum() / 20.0
+        ro = (tenant[out_mask] == inter).sum() / 80.0
+        assert 0.5 * ro < ri < 2.0 * ro
+
+    def test_hot_storm_concentrates_nodes(self):
+        tr = traffic.generate_scenario(
+            "hot_storm", 100.0, 50.0, 10_000, seed=5, storm_frac=0.9,
+            storm_region_frac=0.02, storm_start_frac=0.4,
+            storm_dur_frac=0.2)
+        t, node = tr["t"], tr["node"]
+        in_win = (t >= 40.0) & (t < 60.0)
+        # >= storm_frac of in-window arrivals land in one contiguous
+        # 2% region (width 200): at least 85% sit within one region
+        # width of the in-window median, which no power-law draw does
+        hot = node[in_win]
+        m = np.median(hot)
+        width = 0.02 * 10_000
+        assert (np.abs(hot - m) <= width).mean() >= 0.85
+        out = node[~in_win]
+        assert (np.abs(out - np.median(out)) <= width).mean() < 0.6
+
+    def test_validation(self):
+        g = traffic.generate_scenario
+        with pytest.raises(ValueError, match="unknown scenario"):
+            g("tsunami", 1.0, 1.0, 10)
+        with pytest.raises(ValueError, match="duration_s"):
+            g("steady", -1.0, 1.0, 10)
+        with pytest.raises(ValueError, match="rate_rps"):
+            g("steady", 1.0, 0.0, 10)
+        with pytest.raises(ValueError, match="nodes"):
+            g("steady", 1.0, 1.0, 0)
+        with pytest.raises(ValueError, match="seed"):
+            g("steady", 1.0, 1.0, 10, seed=-1)
+        with pytest.raises(ValueError, match="mix"):
+            g("steady", 1.0, 1.0, 10, mix={"a": 0.0})
+        with pytest.raises(ValueError, match="flash_tenant"):
+            g("flash_crowd", 1.0, 1.0, 10, flash_tenant="nobody")
+        with pytest.raises(ValueError, match="flash_x"):
+            g("flash_crowd", 1.0, 1.0, 10, flash_x=0.5)
+        with pytest.raises(ValueError, match="diurnal_amp"):
+            g("diurnal", 1.0, 1.0, 10, diurnal_amp=1.5)
+        with pytest.raises(ValueError, match="lo"):
+            g("steady", 10.0, 10.0, 10, lo=80, hi=20)
+
+    def test_empty_trace(self):
+        tr = traffic.generate_scenario("steady", 0.0, 5.0, 10)
+        assert tr["length"] == 0 and len(tr["t"]) == 0
+
+
+class _StubTarget:
+    """Deterministic future-returning target: every 3rd best_effort
+    submit overloads, every 4th interactive expires its deadline,
+    every 5th batch submit errors; the rest resolve immediately."""
+
+    def __init__(self):
+        self.seen = {"interactive": 0, "batch": 0, "best_effort": 0}
+
+    def submit(self, node, tenant=None):
+        self.seen[tenant] += 1
+        k = self.seen[tenant]
+        if tenant == "best_effort" and k % 3 == 0:
+            raise qrpc.Overloaded("stub shed")
+        if tenant == "interactive" and k % 4 == 0:
+            raise qrpc.DeadlineExceeded("stub deadline")
+        if tenant == "batch" and k % 5 == 0:
+            raise RuntimeError("stub fault")
+        fut = concurrent.futures.Future()
+        fut.set_result(np.full((3,), float(node), np.float32))
+        return fut
+
+
+class TestReplay:
+    def test_stub_accounting_exact(self, tmp_path):
+        trace = traffic.generate_scenario("steady", 200.0, 3.0, 50,
+                                          seed=11)
+        target = _StubTarget()
+        sink_path = os.fspath(tmp_path / "replay.jsonl")
+        with qm.MetricsSink(sink_path) as sink:
+            rep = traffic.replay(trace, target, speed=4000.0,
+                                 sink=sink)
+        # hand-fold the same trace through the stub's reject law
+        names = [trace["tenants"][i] for i in trace["tenant"]]
+        want = {n: {"offered": 0, "rejected": 0, "deadline_expired": 0,
+                    "failed": 0, "completed": 0}
+                for n in trace["tenants"]}
+        seen = {n: 0 for n in trace["tenants"]}
+        for n in names:
+            w = want[n]
+            w["offered"] += 1
+            seen[n] += 1
+            if n == "best_effort" and seen[n] % 3 == 0:
+                w["rejected"] += 1
+            elif n == "interactive" and seen[n] % 4 == 0:
+                w["deadline_expired"] += 1
+            elif n == "batch" and seen[n] % 5 == 0:
+                w["failed"] += 1
+            else:
+                w["completed"] += 1
+        for n, w in want.items():
+            got = rep["tenants"][n]
+            for k, v in w.items():
+                assert got[k] == v, (n, k)
+            assert got["accepted"] == w["completed"]
+            assert got["latency"]["n"] == w["completed"]
+        assert rep["wall_s"] >= rep["offer_wall_s"] > 0
+        # the JSONL evidence: one kind="replay" record per tenant
+        recs = [r for r in qm.read_jsonl(sink_path)
+                if r.get("kind") == "replay"]
+        assert sorted(r["tenant"] for r in recs) == \
+            sorted(trace["tenants"])
+        for r in recs:
+            assert r["scenario"] == "steady"
+            assert r["offered"] == want[r["tenant"]]["offered"]
+
+    def test_sync_callable_target(self):
+        trace = traffic.generate_scenario("steady", 50.0, 2.0, 20,
+                                          seed=2)
+        calls = []
+        rep = traffic.replay(trace, lambda node, tenant:
+                             calls.append((node, tenant)),
+                             speed=2000.0)
+        total = sum(t["completed"] for t in rep["tenants"].values())
+        assert total == len(calls) == trace["length"]
+        assert all(t["rejected"] == 0 and t["failed"] == 0
+                   for t in rep["tenants"].values())
+
+    def test_serving_overload_counts_as_reject(self):
+        from quiver_tpu.serving import OverloadError
+
+        class _Shedder:
+            def submit(self, node, tenant=None):
+                raise OverloadError("full")
+
+        trace = traffic.generate_scenario("steady", 20.0, 2.0, 10,
+                                          seed=1)
+        rep = traffic.replay(trace, _Shedder(), speed=2000.0)
+        assert sum(t["rejected"] for t in rep["tenants"].values()) \
+            == trace["length"]
+        assert all(t["completed"] == 0 and t["failed"] == 0
+                   for t in rep["tenants"].values())
+
+    def test_speed_validation(self):
+        trace = traffic.generate_scenario("steady", 1.0, 1.0, 10)
+        with pytest.raises(ValueError, match="speed"):
+            traffic.replay(trace, lambda n, t: None, speed=0.0)
+
+    def test_flash_crowd_replay_emits_scenario(self, tmp_path):
+        trace = traffic.generate_scenario("flash_crowd", 60.0, 4.0, 30,
+                                          seed=6)
+        sink_path = os.fspath(tmp_path / "flood.jsonl")
+        with qm.MetricsSink(sink_path) as sink:
+            traffic.replay(trace, lambda n, t: None, speed=3000.0,
+                           sink=sink)
+        with open(sink_path) as fh:
+            recs = [json.loads(line) for line in fh
+                    if json.loads(line).get("kind") == "replay"]
+        assert recs and {r["scenario"] for r in recs} == \
+            {"flash_crowd"}
